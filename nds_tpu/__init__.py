@@ -1,0 +1,10 @@
+"""nds_tpu — TPU-native decision-support benchmark framework on JAX/XLA.
+
+A ground-up rebuild of the capability surface of NVIDIA's NDS v2.0 suite
+(spark-rapids-benchmarks) for TPU: chunked data generation, CSV->Parquet load
+test, seeded query-stream generation, a JAX/XLA columnar SQL engine (Power Run,
+throughput streams, data maintenance), result validation, and a YAML-driven
+orchestrator computing the NDS primary metric.
+"""
+
+__version__ = "0.1.0"

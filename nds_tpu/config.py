@@ -1,0 +1,64 @@
+"""Engine-wide configuration.
+
+One typed config object replaces the reference's three-tier config zoo
+(argparse + bash template `SPARK_CONF` arrays + key=value property files,
+see reference nds/base.template and nds/nds_power.py:306-312). Property files
+are still accepted for interface parity (`load_properties`).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class EngineConfig:
+    # Physical type for DECIMAL columns: "f64" (CPU default — exact enough under the
+    # validator epsilon) or "f32" (TPU MXU/VPU native; pairwise reductions bound error).
+    decimal_physical: str = "f64"
+    # device mesh axis for data-parallel table sharding
+    mesh_shape: tuple[int, ...] = ()
+    mesh_axis_names: tuple[str, ...] = ("shards",)
+    # rows per morsel when streaming host->device
+    chunk_rows: int = 1 << 20
+    # run jitted per-op kernels (True) or pure-numpy fallback (False, debug only)
+    use_jax: bool = True
+
+    @staticmethod
+    def from_property_file(path: str | None) -> "EngineConfig":
+        cfg = EngineConfig()
+        for k, v in load_properties(path).items():
+            key = k.replace("nds.tpu.", "").replace(".", "_")
+            if not hasattr(cfg, key):
+                continue
+            cur = getattr(cfg, key)
+            if isinstance(cur, bool):
+                setattr(cfg, key, v.lower() in ("1", "true", "yes"))
+            elif isinstance(cur, int):
+                setattr(cfg, key, int(v))
+            elif isinstance(cur, str):
+                setattr(cfg, key, v)
+            elif isinstance(cur, tuple):
+                setattr(cfg, key, tuple(int(x) for x in v.split(",") if x.strip()))
+        return cfg
+
+
+def load_properties(path: str | None) -> dict[str, str]:
+    """Parse a java-style key=value property file (reference nds_power.py:306-312)."""
+    props: dict[str, str] = {}
+    if not path:
+        return props
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            name, _, value = line.partition("=")
+            props[name.strip()] = value.strip()
+    return props
+
+
+def enable_x64() -> None:
+    """Enable 64-bit JAX types; required for int64 keys and f64 decimals on CPU."""
+    import jax
+
+    jax.config.update("jax_enable_x64", True)

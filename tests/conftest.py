@@ -1,0 +1,16 @@
+"""Test configuration: force an 8-device virtual CPU mesh before jax import.
+
+Mirrors the reference's multi-node-less testing gap (SURVEY.md §4): the engine's
+multi-chip sharding logic is exercised on a virtual device mesh
+(``xla_force_host_platform_device_count``) so no TPU pod is needed for CI.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,66 @@
+import pyarrow as pa
+import pytest
+
+from nds_tpu.schema import Kind, all_schemas, get_maintenance_schemas, get_schemas
+
+EXPECTED_SOURCE_COLUMNS = {
+    "customer_address": 13, "customer_demographics": 9, "date_dim": 28,
+    "warehouse": 14, "ship_mode": 6, "time_dim": 10, "reason": 3,
+    "income_band": 3, "item": 22, "store": 29, "call_center": 31,
+    "customer": 18, "web_site": 26, "store_returns": 20,
+    "household_demographics": 5, "web_page": 14, "promotion": 19,
+    "catalog_page": 9, "inventory": 4, "catalog_returns": 27,
+    "web_returns": 24, "web_sales": 34, "catalog_sales": 34, "store_sales": 23,
+}
+
+EXPECTED_MAINT_COLUMNS = {
+    "s_purchase_lineitem": 8, "s_purchase": 8, "s_catalog_order": 8,
+    "s_web_order": 8, "s_catalog_order_lineitem": 12, "s_web_order_lineitem": 11,
+    "s_store_returns": 17, "s_catalog_returns": 20, "s_web_returns": 17,
+    "s_inventory": 4, "delete": 2, "inventory_delete": 2,
+}
+
+
+def test_source_table_count():
+    assert set(get_schemas().keys()) == set(EXPECTED_SOURCE_COLUMNS)
+
+
+def test_maintenance_table_count():
+    assert set(get_maintenance_schemas().keys()) == set(EXPECTED_MAINT_COLUMNS)
+
+
+@pytest.mark.parametrize("table,ncols", sorted(EXPECTED_SOURCE_COLUMNS.items()))
+def test_source_column_counts(table, ncols):
+    assert len(get_schemas()[table].columns) == ncols
+
+
+@pytest.mark.parametrize("table,ncols", sorted(EXPECTED_MAINT_COLUMNS.items()))
+def test_maintenance_column_counts(table, ncols):
+    assert len(get_maintenance_schemas()[table].columns) == ncols
+
+
+def test_identifier_width_policy():
+    """ss_ticket_number / sr_ticket_number are 64-bit; other SKs are 32-bit."""
+    s = get_schemas()
+    assert s["store_sales"].column("ss_ticket_number").ctype.kind == Kind.ID64
+    assert s["store_returns"].column("sr_ticket_number").ctype.kind == Kind.ID64
+    assert s["store_sales"].column("ss_item_sk").ctype.kind == Kind.ID
+    arrow = s["store_sales"].arrow_schema()
+    assert arrow.field("ss_ticket_number").type == pa.int64()
+    assert arrow.field("ss_item_sk").type == pa.int32()
+
+
+def test_decimal_toggle():
+    s = get_schemas()["store_sales"]
+    assert s.arrow_schema(True).field("ss_list_price").type == pa.decimal128(7, 2)
+    assert s.arrow_schema(False).field("ss_list_price").type == pa.float64()
+
+
+def test_not_null_flags():
+    s = get_schemas()["customer_address"]
+    assert not s.arrow_schema().field("ca_address_sk").nullable
+    assert s.arrow_schema().field("ca_street_number").nullable
+
+
+def test_all_schemas_merged():
+    assert len(all_schemas()) == 36

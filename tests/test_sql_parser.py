@@ -1,0 +1,155 @@
+import pytest
+
+from nds_tpu.sql import parse_sql, parse_statements
+from nds_tpu.sql.ast_nodes import (
+    Between, BinOp, Case, Cast, CreateView, Delete, DropView, FuncCall, InList,
+    Insert, InSubquery, Interval, Join, Literal, Query, ScalarSubquery, Select,
+    SetOp, Star, SubqueryRef, TableRef,
+)
+from nds_tpu.sql.parser import SqlParseError
+
+
+def test_simple_select():
+    q = parse_sql("select a, b as bb from t where a > 1 limit 10")
+    assert isinstance(q.body, Select)
+    assert q.limit == 10
+    assert q.body.items[1].alias == "bb"
+
+
+def test_cte_and_correlated_subquery():
+    q = parse_sql(
+        """
+        with ctr as (select sr_store_sk k, sum(sr_return_amt) v
+                     from store_returns group by sr_store_sk)
+        select * from ctr c1
+        where c1.v > (select avg(v) * 1.2 from ctr c2 where c1.k = c2.k)
+        """
+    )
+    assert len(q.ctes) == 1
+    pred = q.body.where
+    assert isinstance(pred, BinOp) and isinstance(pred.right, ScalarSubquery)
+
+
+def test_joins():
+    q = parse_sql(
+        "select * from a join b on a.x = b.x left outer join c on b.y = c.y, d"
+    )
+    j = q.body.from_
+    assert isinstance(j, Join) and j.kind == "cross"
+    assert j.left.kind == "left"
+
+
+def test_interval_arithmetic():
+    q = parse_sql("select * from t where d between cast('2000-01-01' as date) "
+                  "and cast('2000-01-01' as date) + interval 30 days")
+    between = q.body.where
+    assert isinstance(between, Between)
+    assert isinstance(between.high, BinOp) and isinstance(between.high.right, Interval)
+    assert between.high.right.unit == "day"
+
+
+def test_date_literal():
+    q = parse_sql("select * from t where d >= date '2002-01-01'")
+    assert q.body.where.right == Literal("2002-01-01", type_hint="date")
+
+
+def test_in_list_and_subquery():
+    q = parse_sql("select * from t where a in (1,2,3) and b not in (select x from s)")
+    land = q.body.where
+    assert isinstance(land.left, InList)
+    assert isinstance(land.right, InSubquery) and land.right.negated
+
+
+def test_case_when():
+    q = parse_sql("select case when a > 0 then 'pos' else 'neg' end from t")
+    assert isinstance(q.body.items[0].expr, Case)
+
+
+def test_window_function():
+    q = parse_sql(
+        "select rank() over (partition by g order by sum(v) desc) rk from t group by g"
+    )
+    fc = q.body.items[0].expr
+    assert isinstance(fc, FuncCall) and fc.over is not None
+    assert len(fc.over.partition_by) == 1
+    assert not fc.over.order_by[0].asc
+
+
+def test_window_frame_is_tolerated():
+    q = parse_sql(
+        "select sum(v) over (partition by g order by d rows between "
+        "unbounded preceding and current row) from t"
+    )
+    assert "unbounded" in q.body.items[0].expr.over.frame
+
+
+def test_rollup_and_grouping():
+    q = parse_sql(
+        "select grouping(a), sum(v) from t group by rollup(a, b)"
+    )
+    assert q.body.group_by.rollup
+
+
+def test_set_ops_precedence():
+    q = parse_sql("select a from x union all select a from y intersect select a from z")
+    assert isinstance(q.body, SetOp) and q.body.op == "union"
+    assert isinstance(q.body.right, SetOp) and q.body.right.op == "intersect"
+
+
+def test_count_distinct_star():
+    q = parse_sql("select count(*), count(distinct a) from t")
+    c0, c1 = (it.expr for it in q.body.items)
+    assert isinstance(c0.args[0], Star)
+    assert c1.distinct
+
+
+def test_backtick_identifiers():
+    q = parse_sql("select `sum sales`, sumsales from t order by `sum sales`")
+    assert q.body.items[0].expr.parts == ("sum sales",)
+
+
+def test_string_escape():
+    q = parse_sql("select * from t where s = 'Doesn''t'")
+    assert q.body.where.right == Literal("Doesn't")
+
+
+def test_maintenance_statements():
+    stmts = parse_statements(
+        """
+        create temp view v as (select * from s_store_returns);
+        insert into store_returns (select * from v);
+        delete from store_sales where ss_sold_date_sk >= (select min(d_date_sk)
+          from date_dim where d_date between 'DATE1' and 'DATE2');
+        drop view v;
+        """
+    )
+    assert [type(s) for s in stmts] == [CreateView, Insert, Delete, DropView]
+
+
+def test_parse_error_reports_context():
+    with pytest.raises(SqlParseError):
+        parse_sql("select from where")
+
+
+def test_exists_and_not_exists():
+    q = parse_sql(
+        "select * from t where exists (select 1 from s where s.k = t.k) "
+        "and not exists (select 1 from u where u.k = t.k)"
+    )
+    assert q.body.where is not None
+
+
+def test_order_by_nulls():
+    q = parse_sql("select a from t order by a desc nulls last, b nulls first")
+    assert q.order_by[0].nulls_first is False
+    assert q.order_by[1].nulls_first is True
+
+
+def test_subquery_in_from():
+    q = parse_sql("select * from (select a from t) sub where sub.a > 0")
+    assert isinstance(q.body.from_, SubqueryRef)
+
+
+def test_concat_operator():
+    q = parse_sql("select c_last_name || ', ' || c_first_name from customer")
+    assert isinstance(q.body.items[0].expr, BinOp)

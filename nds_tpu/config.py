@@ -38,7 +38,11 @@ class EngineConfig:
             elif isinstance(cur, str):
                 setattr(cfg, key, v)
             elif isinstance(cur, tuple):
-                setattr(cfg, key, tuple(int(x) for x in v.split(",") if x.strip()))
+                parts = [x.strip() for x in v.split(",") if x.strip()]
+                try:
+                    setattr(cfg, key, tuple(int(x) for x in parts))
+                except ValueError:
+                    setattr(cfg, key, tuple(parts))
         return cfg
 
 

@@ -1,0 +1,16 @@
+"""JAX/XLA columnar SQL engine.
+
+Replaces the reference's Spark/RAPIDS execution layer (the work measured by
+nds_power.py / nds_transcode.py) with a TPU-first design:
+
+- columnar tables: device arrays + validity masks; strings dictionary-encoded
+  so all relational compute is integer/float math the MXU/VPU can run;
+- host does shape discovery (group cardinalities, join sizes), XLA does the
+  FLOPs (segment reductions, sort, gather/scatter) — no data-dependent shapes
+  inside compiled code;
+- multi-chip scaling via jax.sharding over a Mesh with psum/all_gather/
+  all_to_all collectives (see nds_tpu.parallel), not executor shuffles.
+"""
+from .session import Session
+
+__all__ = ["Session"]

@@ -1,0 +1,132 @@
+"""Arrow <-> engine Table conversion.
+
+Arrow is the host-side interchange format (the `collect()` analog in the
+reference pulls rows to the Spark driver, nds_power.py:131; here results
+materialize as Arrow tables for reporting/validation/output writing).
+"""
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from .column import Column, Table
+
+
+def engine_dtype(t: pa.DataType) -> str:
+    if pa.types.is_integer(t):
+        return "int"
+    if pa.types.is_decimal(t) or pa.types.is_floating(t):
+        return "float"
+    if pa.types.is_date(t):
+        return "date"
+    if pa.types.is_boolean(t):
+        return "bool"
+    if pa.types.is_string(t) or pa.types.is_large_string(t) or \
+            pa.types.is_dictionary(t):
+        return "str"
+    raise TypeError(f"unsupported arrow type {t}")
+
+
+def engine_schema(schema: pa.Schema) -> tuple[list[str], list[str]]:
+    names = list(schema.names)
+    dtypes = [engine_dtype(f.type) for f in schema]
+    return names, dtypes
+
+
+def _chunked_to_array(arr: pa.ChunkedArray | pa.Array) -> pa.Array:
+    if isinstance(arr, pa.ChunkedArray):
+        return arr.combine_chunks()
+    return arr
+
+
+def from_arrow_column(arr) -> Column:
+    arr = _chunked_to_array(arr)
+    t = arr.type
+    dtype = engine_dtype(t)
+    null_count = arr.null_count
+    if dtype == "str":
+        if not pa.types.is_dictionary(t):
+            arr = arr.dictionary_encode()
+        codes = arr.indices.to_numpy(zero_copy_only=False)
+        codes = np.where(np.isnan(codes.astype(np.float64)), -1, codes) \
+            if codes.dtype.kind == "f" else codes
+        codes = codes.astype(np.int32)
+        valid = None
+        if null_count:
+            valid = ~np.asarray(arr.is_null())
+            codes = np.where(valid, codes, -1)
+        dictionary = np.asarray(arr.dictionary.to_pylist(), dtype=object)
+        return Column("str", codes, valid, dictionary)
+    if dtype == "date":
+        days = arr.cast(pa.int32()).to_numpy(zero_copy_only=False)
+        valid = ~np.asarray(arr.is_null()) if null_count else None
+        return Column("date", np.asarray(days, dtype=np.int32), valid)
+    if dtype == "float":
+        if pa.types.is_decimal(t):
+            arr = arr.cast(pa.float64())
+        vals = arr.to_numpy(zero_copy_only=False).astype(np.float64)
+        valid = ~np.asarray(arr.is_null()) if null_count else None
+        if valid is not None:
+            vals = np.where(valid, vals, 0.0)
+        return Column("float", vals, valid)
+    if dtype == "bool":
+        valid = ~np.asarray(arr.is_null()) if null_count else None
+        vals = arr.to_numpy(zero_copy_only=False)
+        vals = np.asarray(vals, dtype=bool)
+        return Column("bool", vals, valid)
+    # int
+    valid = ~np.asarray(arr.is_null()) if null_count else None
+    vals = arr.to_numpy(zero_copy_only=False)
+    if valid is not None:
+        vals = np.where(valid, vals, 0)
+    return Column("int", np.asarray(vals, dtype=np.int64), valid)
+
+
+def from_arrow(table: pa.Table) -> Table:
+    return Table(list(table.schema.names),
+                 [from_arrow_column(table.column(i))
+                  for i in range(table.num_columns)])
+
+
+def to_arrow_column(col: Column) -> pa.Array:
+    v = col.validity
+    mask = None if col.valid is None else ~col.valid
+    if col.dtype == "str":
+        codes = np.asarray(col.data)
+        d = col.dictionary if col.dictionary is not None \
+            else np.empty(0, dtype=object)
+        null_mask = (codes < 0) | ~v
+        safe = np.where(codes >= 0, codes, 0)
+        values = pa.array(list(d), type=pa.string())
+        indices = pa.array(safe.astype(np.int32),
+                           mask=null_mask if null_mask.any() else None)
+        return pa.DictionaryArray.from_arrays(indices, values).cast(pa.string())
+    if col.dtype == "date":
+        return pa.array(np.asarray(col.data, dtype=np.int32), type=pa.date32(),
+                        mask=mask)
+    if col.dtype == "float":
+        return pa.array(np.asarray(col.data, dtype=np.float64), mask=mask)
+    if col.dtype == "bool":
+        return pa.array(np.asarray(col.data, dtype=bool), mask=mask)
+    return pa.array(np.asarray(col.data, dtype=np.int64), mask=mask)
+
+
+def to_arrow(table: Table) -> pa.Table:
+    arrays = [to_arrow_column(c) for c in table.columns]
+    return pa.table(dict(zip(_dedupe(table.names), arrays))) \
+        if len(set(table.names)) != len(table.names) else \
+        pa.Table.from_arrays(arrays, names=table.names)
+
+
+def _dedupe(names: list[str]) -> list[str]:
+    seen: dict[str, int] = {}
+    out = []
+    for n in names:
+        if n in seen:
+            seen[n] += 1
+            out.append(f"{n}_{seen[n]}")
+        else:
+            seen[n] = 0
+            out.append(n)
+    return out

@@ -1,0 +1,179 @@
+"""Bound logical plan and expression IR.
+
+The planner resolves every name to a column *position* in its input relation,
+so self-joins and alias shadowing are settled before execution. Plan nodes are
+relational; bound expressions are positional trees the expression evaluator
+turns into vectorized JAX/numpy compute.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+# --------------------------------------------------------------------------
+# bound expressions
+# --------------------------------------------------------------------------
+
+@dataclass
+class BExpr:
+    dtype: str  # "int" | "float" | "bool" | "date" | "str"
+
+
+@dataclass
+class BCol(BExpr):
+    index: int
+    name: str = ""
+
+
+@dataclass
+class BLit(BExpr):
+    value: object  # python int/float/str/bool/None; date as epoch-days int
+
+
+@dataclass
+class BCall(BExpr):
+    op: str
+    args: list[BExpr] = field(default_factory=list)
+    extra: object = None  # op-specific payload (e.g. cast target, like pattern)
+
+
+@dataclass
+class BScalarSubquery(BExpr):
+    plan: "PlanNode"
+
+
+@dataclass
+class AggSpec:
+    func: str                 # sum, count, count_star, avg, min, max, stddev_samp
+    arg: Optional[BExpr]      # None for count(*)
+    distinct: bool = False
+    name: str = ""
+
+    @property
+    def dtype(self) -> str:
+        if self.func in ("count", "count_star"):
+            return "int"
+        if self.func in ("avg", "stddev_samp"):
+            return "float"
+        return self.arg.dtype if self.arg is not None else "int"
+
+
+@dataclass
+class SortKey:
+    expr: BExpr
+    asc: bool = True
+    nulls_first: Optional[bool] = None  # None => Spark default (asc: first, desc: last)
+
+
+@dataclass
+class WindowFunc:
+    func: str                     # rank, dense_rank, row_number, sum, avg, min, max, count
+    arg: Optional[BExpr]
+    partition_by: list[BExpr]
+    order_by: list[SortKey]
+    name: str = ""
+
+    @property
+    def dtype(self) -> str:
+        if self.func in ("rank", "dense_rank", "row_number", "count"):
+            return "int"
+        if self.func == "avg":
+            return "float"
+        return self.arg.dtype if self.arg is not None else "int"
+
+
+# --------------------------------------------------------------------------
+# plan nodes — every node exposes `out_names`/`out_dtypes` for its output
+# --------------------------------------------------------------------------
+
+@dataclass
+class PlanNode:
+    out_names: list[str] = field(default_factory=list, kw_only=True)
+    out_dtypes: list[str] = field(default_factory=list, kw_only=True)
+
+
+@dataclass
+class ScanNode(PlanNode):
+    table: str
+    columns: list[str]  # physical columns to read, in output order
+
+
+@dataclass
+class FilterNode(PlanNode):
+    child: PlanNode
+    predicate: BExpr
+
+
+@dataclass
+class ProjectNode(PlanNode):
+    child: PlanNode
+    exprs: list[BExpr]
+
+
+@dataclass
+class JoinNode(PlanNode):
+    left: PlanNode
+    right: PlanNode
+    kind: str                 # inner, left, right, full, cross, semi, anti
+    left_keys: list[BExpr] = field(default_factory=list)
+    right_keys: list[BExpr] = field(default_factory=list)
+    residual: Optional[BExpr] = None  # extra non-equi condition, over combined schema
+    null_aware: bool = False  # NOT IN semantics for anti joins
+
+
+@dataclass
+class AggregateNode(PlanNode):
+    child: PlanNode
+    group_exprs: list[BExpr] = field(default_factory=list)
+    aggs: list[AggSpec] = field(default_factory=list)
+    rollup: bool = False
+    # output: group cols, then agg cols, then (if rollup) int col "__grouping_id"
+
+
+@dataclass
+class WindowNode(PlanNode):
+    child: PlanNode
+    funcs: list[WindowFunc] = field(default_factory=list)
+    # output: child cols, then one col per window func
+
+
+@dataclass
+class SortNode(PlanNode):
+    child: PlanNode
+    keys: list[SortKey] = field(default_factory=list)
+
+
+@dataclass
+class LimitNode(PlanNode):
+    child: PlanNode
+    n: int = 0
+
+
+@dataclass
+class DistinctNode(PlanNode):
+    child: PlanNode
+
+
+@dataclass
+class SetOpNode(PlanNode):
+    op: str    # union, intersect, except
+    all: bool
+    left: PlanNode
+    right: PlanNode
+
+
+@dataclass
+class MaterializedNode(PlanNode):
+    """An already-computed table injected into the plan (CTE results, views)."""
+    table: object  # engine.column.Table
+    label: str = ""
+
+
+def walk(node: PlanNode):
+    """Pre-order traversal of a plan tree."""
+    yield node
+    for f in ("child", "left", "right"):
+        sub = getattr(node, f, None)
+        if isinstance(sub, PlanNode):
+            yield from walk(sub)

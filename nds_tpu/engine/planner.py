@@ -1,0 +1,1404 @@
+"""AST -> bound logical plan.
+
+Responsibilities:
+- name resolution (qualifiers, aliases, CTEs, self-joins) to column positions;
+- join-graph extraction from comma-joins + WHERE equalities, with a
+  size-heuristic greedy join order (facts probe, dimensions build);
+- subquery handling: uncorrelated scalars (runtime-evaluated), IN/EXISTS as
+  semi/anti joins, and decorrelation of equality-correlated scalar aggregate
+  subqueries into grouped left joins (the TPC-DS q1/q6/q44 pattern);
+- aggregate & window rebinding: aggregate calls and group expressions become
+  positional columns for post-agg expressions (HAVING/SELECT/ORDER BY).
+
+The reference delegates all of this to Spark Catalyst (nds_power.py:129
+`spark.sql(query)`); this module is the TPU framework's Catalyst analog.
+"""
+from __future__ import annotations
+
+import datetime as _dt
+from dataclasses import dataclass, field, replace
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..sql import ast_nodes as A
+from . import plan as P
+
+
+class PlanError(ValueError):
+    pass
+
+
+# engine dtype helpers -------------------------------------------------------
+
+_AGG_FUNCS = {"sum", "avg", "min", "max", "count", "stddev_samp", "stddev"}
+_WINDOW_ONLY = {"rank", "dense_rank", "row_number"}
+
+
+def _date_to_days(text: str) -> int:
+    y, m, d = text.split("-")
+    return (_dt.date(int(y), int(m), int(d)) - _dt.date(1970, 1, 1)).days
+
+
+@dataclass
+class ScopeEntry:
+    qualifier: Optional[str]
+    name: str
+    dtype: str
+    index: int
+
+
+@dataclass
+class Scope:
+    entries: list[ScopeEntry] = field(default_factory=list)
+    parent: Optional["Scope"] = None  # outer query scope (correlation)
+
+    def resolve_local(self, name: str, qualifier: Optional[str]
+                      ) -> Optional[ScopeEntry]:
+        hits = [e for e in self.entries
+                if e.name == name and (qualifier is None or e.qualifier == qualifier)]
+        if len(hits) > 1:
+            # identical source column visible through one qualifier twice is fine
+            if len({h.index for h in hits}) > 1:
+                raise PlanError(f"ambiguous column {qualifier + '.' if qualifier else ''}{name}")
+        return hits[0] if hits else None
+
+    def width(self) -> int:
+        return max((e.index for e in self.entries), default=-1) + 1
+
+
+@dataclass
+class Catalog:
+    """Maps table names to (schema, row-count estimate, loader)."""
+    tables: dict = field(default_factory=dict)  # name -> (names, dtypes, est_rows)
+
+    def schema(self, name: str) -> tuple[list[str], list[str]]:
+        if name not in self.tables:
+            raise PlanError(f"unknown table {name!r}")
+        names, dtypes, _ = self.tables[name]
+        return names, dtypes
+
+    def est_rows(self, name: str) -> int:
+        return self.tables[name][2] if name in self.tables else 1000
+
+
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _Unit:
+    """One relation participating in the FROM join graph."""
+    plan: P.PlanNode
+    entries: list[ScopeEntry]      # local indices 0..w-1
+    est_rows: float
+    filters: list[A.Node] = field(default_factory=list)
+
+
+class Planner:
+    def __init__(self, catalog: Catalog):
+        self.catalog = catalog
+
+    # -- public ------------------------------------------------------------
+    def plan_query(self, q: A.Query, outer: Optional[Scope] = None,
+                   ctes: Optional[dict] = None) -> P.PlanNode:
+        ctes = dict(ctes or {})
+        for name, cq in q.ctes:
+            ctes[name] = self.plan_query(cq, outer=None, ctes=ctes)
+        node = self._plan_body(q.body, outer, ctes, q.order_by, q.limit)
+        return node
+
+    # -- query body ---------------------------------------------------------
+    def _plan_body(self, body, outer, ctes, order_by, limit) -> P.PlanNode:
+        if isinstance(body, A.SetOp):
+            left = self._plan_body(body.left, outer, ctes, [], None)
+            right = self._plan_body(body.right, outer, ctes, [], None)
+            if len(left.out_names) != len(right.out_names):
+                raise PlanError("set operation column count mismatch")
+            node = P.SetOpNode(body.op, body.all, left, right,
+                               out_names=list(left.out_names),
+                               out_dtypes=list(left.out_dtypes))
+            node = self._order_limit_by_position(node, order_by, limit)
+            return node
+        if isinstance(body, A.Query):
+            sub = self.plan_query(body, outer, ctes)
+            return self._order_limit_by_position(sub, order_by, limit)
+        if isinstance(body, A.Select):
+            return self._plan_select(body, outer, ctes, order_by, limit)
+        raise PlanError(f"unsupported query body {type(body).__name__}")
+
+    def _order_limit_by_position(self, node: P.PlanNode, order_by, limit):
+        if order_by:
+            scope = Scope([ScopeEntry(None, n, d, i)
+                           for i, (n, d) in enumerate(zip(node.out_names,
+                                                          node.out_dtypes))])
+            keys = []
+            for si in order_by:
+                e = self._bind_output_sort(si.expr, scope, node)
+                keys.append(P.SortKey(e, si.asc, si.nulls_first))
+            node = P.SortNode(node, keys=keys, out_names=list(node.out_names),
+                              out_dtypes=list(node.out_dtypes))
+        if limit is not None:
+            node = P.LimitNode(node, n=limit, out_names=list(node.out_names),
+                               out_dtypes=list(node.out_dtypes))
+        return node
+
+    def _bind_output_sort(self, expr, scope, node):
+        if isinstance(expr, A.Literal) and isinstance(expr.value, int):
+            idx = expr.value - 1
+            if not (0 <= idx < len(node.out_names)):
+                raise PlanError(f"ORDER BY position {expr.value} out of range")
+            return P.BCol(node.out_dtypes[idx], idx, node.out_names[idx])
+        binder = _Binder(self, scope, ctes={}, allow_outer=False)
+        return binder.bind(expr)
+
+    # -- SELECT ------------------------------------------------------------
+    def _plan_select(self, sel: A.Select, outer, ctes, order_by, limit
+                     ) -> P.PlanNode:
+        # FROM + WHERE (join graph)
+        rel, scope, deferred = self._plan_from_where(sel, outer, ctes)
+
+        # expand stars
+        items: list[A.SelectItem] = []
+        for it in sel.items:
+            if isinstance(it.expr, A.Star):
+                for e in scope.entries:
+                    if it.expr.qualifier is None or e.qualifier == it.expr.qualifier:
+                        items.append(A.SelectItem(
+                            A.ColumnRef((e.qualifier, e.name) if e.qualifier
+                                        else (e.name,)), None))
+            else:
+                items.append(it)
+
+        # aggregate detection
+        agg_calls = []
+        for it in items:
+            _collect_aggs(it.expr, agg_calls)
+        if sel.having is not None:
+            _collect_aggs(sel.having, agg_calls)
+        for si in order_by:
+            _collect_aggs(si.expr, agg_calls)
+        has_agg = bool(agg_calls) or sel.group_by is not None
+
+        binder = _Binder(self, scope, ctes, outer=outer)
+
+        if has_agg:
+            ngroup = len(sel.group_by.exprs) if sel.group_by else 0
+            rel, scope, rebound = self._plan_aggregate(
+                rel, scope, sel, items, agg_calls, binder, ctes, outer)
+            binder = _Binder(self, scope, ctes, outer=outer,
+                             rewrites=rebound, num_group_cols=ngroup)
+
+        # windows
+        win_calls: list[A.FuncCall] = []
+        for it in items:
+            _collect_windows(it.expr, win_calls)
+        for si in order_by:
+            _collect_windows(si.expr, win_calls)
+        if win_calls:
+            rel, scope, binder = self._plan_windows(rel, scope, win_calls, binder,
+                                                    ctes, outer)
+
+        # HAVING
+        if sel.having is not None:
+            pred = binder.bind(sel.having)
+            rel = P.FilterNode(rel, pred, out_names=list(rel.out_names),
+                               out_dtypes=list(rel.out_dtypes))
+
+        # SELECT projection
+        proj_exprs, proj_names = [], []
+        for it in items:
+            e = binder.bind(it.expr)
+            proj_exprs.append(e)
+            proj_names.append(it.alias or _display_name(it.expr))
+        project = P.ProjectNode(rel, proj_exprs,
+                                out_names=proj_names,
+                                out_dtypes=[e.dtype for e in proj_exprs])
+
+        node: P.PlanNode = project
+        if sel.distinct:
+            node = P.DistinctNode(node, out_names=list(node.out_names),
+                                  out_dtypes=list(node.out_dtypes))
+            node = self._order_limit_output(node, order_by, limit, items,
+                                            proj_exprs)
+            return node
+
+        # ORDER BY below-project binding: sort keys are exprs over project input
+        if order_by:
+            keys = []
+            for si in order_by:
+                e = self._bind_sort_key(si.expr, items, proj_exprs, binder,
+                                        project)
+                keys.append(P.SortKey(e, si.asc, si.nulls_first))
+            # sort the project INPUT, so keys may use non-projected columns
+            sorted_child = P.SortNode(rel, keys=keys,
+                                      out_names=list(rel.out_names),
+                                      out_dtypes=list(rel.out_dtypes))
+            project = P.ProjectNode(sorted_child, proj_exprs,
+                                    out_names=proj_names,
+                                    out_dtypes=[e.dtype for e in proj_exprs])
+            node = project
+        if limit is not None:
+            node = P.LimitNode(node, n=limit, out_names=list(node.out_names),
+                               out_dtypes=list(node.out_dtypes))
+        return node
+
+    def _order_limit_output(self, node, order_by, limit, items, proj_exprs):
+        """ORDER BY over the (distinct) projected output, by alias/position."""
+        if order_by:
+            scope = Scope([ScopeEntry(None, n, d, i)
+                           for i, (n, d) in enumerate(zip(node.out_names,
+                                                          node.out_dtypes))])
+            keys = []
+            for si in order_by:
+                e = self._bind_output_sort_item(si.expr, scope, node, items)
+                keys.append(P.SortKey(e, si.asc, si.nulls_first))
+            node = P.SortNode(node, keys=keys, out_names=list(node.out_names),
+                              out_dtypes=list(node.out_dtypes))
+        if limit is not None:
+            node = P.LimitNode(node, n=limit, out_names=list(node.out_names),
+                               out_dtypes=list(node.out_dtypes))
+        return node
+
+    def _bind_output_sort_item(self, expr, scope, node, items):
+        if isinstance(expr, A.Literal) and isinstance(expr.value, int):
+            idx = expr.value - 1
+            return P.BCol(node.out_dtypes[idx], idx, node.out_names[idx])
+        for i, it in enumerate(items):
+            if it.alias and expr == A.ColumnRef((it.alias,)):
+                return P.BCol(node.out_dtypes[i], i, node.out_names[i])
+            if it.expr == expr:
+                return P.BCol(node.out_dtypes[i], i, node.out_names[i])
+        binder = _Binder(self, scope, ctes={}, allow_outer=False)
+        return binder.bind(expr)
+
+    def _bind_sort_key(self, expr, items, proj_exprs, binder, project):
+        # ordinal -> projected expr
+        if isinstance(expr, A.Literal) and isinstance(expr.value, int):
+            idx = expr.value - 1
+            if not (0 <= idx < len(proj_exprs)):
+                raise PlanError(f"ORDER BY position {expr.value} out of range")
+            return proj_exprs[idx]
+        # alias or identical expression -> projected expr
+        for it, bound in zip(items, proj_exprs):
+            if it.alias is not None and expr == A.ColumnRef((it.alias,)):
+                return bound
+            if it.expr == expr:
+                return bound
+        return binder.bind(expr)
+
+    # -- FROM/WHERE join graph ----------------------------------------------
+    def _plan_from_where(self, sel: A.Select, outer, ctes):
+        if sel.from_ is None:
+            raise PlanError("SELECT without FROM is not supported")
+        units = self._flatten_from(sel.from_, ctes, outer)
+
+        # full scope in declaration order
+        scope_entries, offset = [], 0
+        unit_offsets = []
+        for u in units:
+            unit_offsets.append(offset)
+            for e in u.entries:
+                scope_entries.append(replace(e, index=offset + e.index))
+            offset += len(u.entries)
+        scope = Scope(scope_entries, parent=outer)
+
+        conjuncts = _split_and(sel.where) if sel.where is not None else []
+        edges, residuals, subq_conjs = [], [], []
+        for c in conjuncts:
+            if _has_subquery(c):
+                subq_conjs.append(c)
+                continue
+            refs = self._referenced_units(c, units, scope, unit_offsets)
+            if refs is None:
+                residuals.append(c)  # references outer scope: bind later
+            elif len(refs) <= 1:
+                if refs:
+                    units[next(iter(refs))].filters.append(c)
+                else:
+                    residuals.append(c)  # constant predicate
+            elif (len(refs) == 2 and isinstance(c, A.BinOp) and c.op == "="):
+                lrefs = self._referenced_units(c.left, units, scope, unit_offsets)
+                rrefs = self._referenced_units(c.right, units, scope, unit_offsets)
+                if lrefs is not None and rrefs is not None and \
+                        len(lrefs) == 1 and len(rrefs) == 1 and lrefs != rrefs:
+                    la, rb = next(iter(lrefs)), next(iter(rrefs))
+                    edges.append((la, rb, c.left, c.right))
+                else:
+                    residuals.append(c)
+            else:
+                residuals.append(c)
+
+        # push single-unit filters
+        for u in units:
+            for f in u.filters:
+                local_scope = Scope(u.entries, parent=outer)
+                b = _Binder(self, local_scope, ctes, outer=outer)
+                pred = b.bind(f)
+                u.plan = P.FilterNode(u.plan, pred,
+                                      out_names=list(u.plan.out_names),
+                                      out_dtypes=list(u.plan.out_dtypes))
+                u.est_rows = max(1.0, u.est_rows / 5.0)
+            u.filters = []
+
+        rel, col_map = self._join_units(units, edges, ctes, outer)
+
+        # permutation back to declaration order
+        perm = [None] * len(scope_entries)
+        for ui, u in enumerate(units):
+            for e in u.entries:
+                perm[unit_offsets[ui] + e.index] = col_map[ui] + e.index
+        exprs = [P.BCol(scope_entries[i].dtype, perm[i], scope_entries[i].name)
+                 for i in range(len(scope_entries))]
+        rel = P.ProjectNode(rel, exprs,
+                            out_names=[e.name for e in scope_entries],
+                            out_dtypes=[e.dtype for e in scope_entries])
+
+        binder = _Binder(self, scope, ctes, outer=outer)
+        for c in residuals:
+            pred = binder.bind(c)
+            rel = P.FilterNode(rel, pred, out_names=list(rel.out_names),
+                               out_dtypes=list(rel.out_dtypes))
+
+        deferred = []
+        for c in subq_conjs:
+            rel = self._apply_subquery_conjunct(rel, scope, c, ctes, outer)
+        return rel, scope, deferred
+
+    def _flatten_from(self, node, ctes, outer) -> list[_Unit]:
+        """Comma/cross joins become separate units; explicit joins one unit."""
+        if isinstance(node, A.Join) and node.kind == "cross" and node.on is None:
+            return self._flatten_from(node.left, ctes, outer) + \
+                self._flatten_from(node.right, ctes, outer)
+        return [self._plan_relation(node, ctes, outer)]
+
+    def _plan_relation(self, node, ctes, outer) -> _Unit:
+        if isinstance(node, A.TableRef):
+            qual = node.alias or node.name
+            if node.name in ctes:
+                sub = ctes[node.name]
+                entries = [ScopeEntry(qual, n, d, i)
+                           for i, (n, d) in enumerate(zip(sub.out_names,
+                                                          sub.out_dtypes))]
+                return _Unit(sub, entries, est_rows=10_000.0)
+            names, dtypes = self.catalog.schema(node.name)
+            scan = P.ScanNode(node.name, list(names),
+                              out_names=list(names), out_dtypes=list(dtypes))
+            entries = [ScopeEntry(qual, n, d, i)
+                       for i, (n, d) in enumerate(zip(names, dtypes))]
+            return _Unit(scan, entries, est_rows=float(self.catalog.est_rows(node.name)))
+        if isinstance(node, A.SubqueryRef):
+            sub = self.plan_query(node.query, outer=outer, ctes=ctes)
+            entries = [ScopeEntry(node.alias, n, d, i)
+                       for i, (n, d) in enumerate(zip(sub.out_names,
+                                                      sub.out_dtypes))]
+            return _Unit(sub, entries, est_rows=10_000.0)
+        if isinstance(node, A.Join):
+            left = self._plan_relation(node.left, ctes, outer)
+            right = self._plan_relation(node.right, ctes, outer)
+            combined_entries = list(left.entries) + [
+                replace(e, index=e.index + len(left.entries))
+                for e in right.entries]
+            scope = Scope(combined_entries, parent=outer)
+            kind = node.kind
+            lkeys, rkeys, residual = [], [], None
+            if node.on is not None:
+                binder = _Binder(self, scope, ctes, outer=outer)
+                nleft = len(left.entries)
+                res_parts = []
+                for c in _split_and(node.on):
+                    pair = self._equi_pair(c, scope, nleft, binder)
+                    if pair is not None:
+                        lkeys.append(pair[0])
+                        rkeys.append(pair[1])
+                    else:
+                        res_parts.append(binder.bind(c))
+                residual = _and_all(res_parts)
+            elif kind not in ("cross",):
+                kind = "cross"
+            out_names = [e.name for e in combined_entries]
+            out_dtypes = [e.dtype for e in combined_entries]
+            jn = P.JoinNode(left.plan, right.plan, kind, lkeys, rkeys, residual,
+                            out_names=out_names, out_dtypes=out_dtypes)
+            return _Unit(jn, combined_entries,
+                         est_rows=max(left.est_rows, right.est_rows))
+        raise PlanError(f"unsupported FROM element {type(node).__name__}")
+
+    def _equi_pair(self, c, scope, nleft, binder):
+        if not (isinstance(c, A.BinOp) and c.op == "="):
+            return None
+        try:
+            lb = binder.bind(c.left)
+            rb = binder.bind(c.right)
+        except PlanError:
+            return None
+        lcols, rcols = _col_indices(lb), _col_indices(rb)
+        if lcols and rcols:
+            if max(lcols) < nleft and min(rcols) >= nleft:
+                return lb, _shift(rb, -nleft)
+            if max(rcols) < nleft and min(lcols) >= nleft:
+                return rb, _shift(lb, -nleft)
+        return None
+
+    def _referenced_units(self, node, units, scope, unit_offsets):
+        """Set of unit ids referenced by the AST; None if outer refs present."""
+        refs: set[int] = set()
+        outer_seen = [False]
+
+        def visit(x):
+            if isinstance(x, A.ColumnRef):
+                e = scope.resolve_local(x.name, x.qualifier)
+                if e is None:
+                    outer_seen[0] = True
+                    return
+                ui = 0
+                for i, off in enumerate(unit_offsets):
+                    if e.index >= off:
+                        ui = i
+                refs.add(ui)
+            for child in _children(x):
+                visit(child)
+        visit(node)
+        if outer_seen[0]:
+            return None
+        return refs
+
+    def _join_units(self, units, edges, ctes, outer):
+        """Greedy join: start from the largest (fact) unit, attach connected
+        units smallest-first (dimension build sides)."""
+        n = len(units)
+        if n == 1:
+            return units[0].plan, {0: 0}
+        remaining = set(range(n))
+        start = max(remaining, key=lambda i: units[i].est_rows)
+        current_plan = units[start].plan
+        col_map = {start: 0}
+        width = len(units[start].entries)
+        remaining.discard(start)
+        placed = {start}
+        while remaining:
+            connected = [i for i in remaining
+                         if any((a in placed and b == i) or (b in placed and a == i)
+                                for a, b, _, _ in edges)]
+            pick = min(connected, key=lambda i: units[i].est_rows) if connected \
+                else min(remaining, key=lambda i: units[i].est_rows)
+            unit = units[pick]
+            lkeys, rkeys = [], []
+            for a, b, lexpr, rexpr in edges:
+                if a in placed and b == pick:
+                    okey, ikey = lexpr, rexpr
+                elif b in placed and a == pick:
+                    okey, ikey = rexpr, lexpr
+                else:
+                    continue
+                lkeys.append(self._bind_in_joined(okey, units, col_map, ctes, outer))
+                rkeys.append(self._bind_in_unit(ikey, unit, ctes, outer))
+            kind = "inner" if lkeys else "cross"
+            out_names = current_plan.out_names + unit.plan.out_names
+            out_dtypes = current_plan.out_dtypes + unit.plan.out_dtypes
+            current_plan = P.JoinNode(current_plan, unit.plan, kind,
+                                      lkeys, rkeys, None,
+                                      out_names=out_names, out_dtypes=out_dtypes)
+            col_map[pick] = width
+            width += len(unit.entries)
+            placed.add(pick)
+            remaining.discard(pick)
+        return current_plan, col_map
+
+    def _bind_in_joined(self, expr, units, col_map, ctes, outer):
+        entries = []
+        for ui, off in col_map.items():
+            for e in units[ui].entries:
+                entries.append(replace(e, index=off + e.index))
+        return _Binder(self, Scope(entries, parent=outer), ctes,
+                       outer=outer).bind(expr)
+
+    def _bind_in_unit(self, expr, unit, ctes, outer):
+        return _Binder(self, Scope(unit.entries, parent=outer), ctes,
+                       outer=outer).bind(expr)
+
+    # -- subquery conjuncts --------------------------------------------------
+    def _apply_subquery_conjunct(self, rel, scope, c, ctes, outer):
+        binder = _Binder(self, scope, ctes, outer=outer)
+        width = len(rel.out_names)
+
+        neg = False
+        node = c
+        while isinstance(node, A.UnaryOp) and node.op == "not":
+            neg = not neg
+            node = node.operand
+
+        if isinstance(node, A.Exists):
+            if node.negated:
+                neg = not neg
+            return self._semi_anti(rel, scope, node.query, None, neg, ctes)
+        if isinstance(node, A.InSubquery):
+            neg2 = neg ^ node.negated
+            return self._semi_anti(rel, scope, node.query, node.expr, neg2, ctes)
+
+        # comparison containing scalar subqueries
+        rel2, scope2, rewritten = self._decorrelate_scalars(rel, scope, node,
+                                                            ctes)
+        binder2 = _Binder(self, scope2, ctes, outer=outer,
+                          subquery_cols=rewritten)
+        pred = binder2.bind(node)
+        if neg:
+            pred = P.BCall("bool", "not", [pred])
+        filtered = P.FilterNode(rel2, pred, out_names=list(rel2.out_names),
+                                out_dtypes=list(rel2.out_dtypes))
+        if len(rel2.out_names) != width:
+            exprs = [P.BCol(rel2.out_dtypes[i], i, rel2.out_names[i])
+                     for i in range(width)]
+            return P.ProjectNode(filtered, exprs,
+                                 out_names=list(rel2.out_names[:width]),
+                                 out_dtypes=list(rel2.out_dtypes[:width]))
+        return filtered
+
+    def _semi_anti(self, rel, scope, subq: A.Query, in_expr, negated, ctes):
+        """EXISTS/IN subqueries as semi/anti joins with correlation keys."""
+        sub_plan, corr_pairs, inner_keys = self._plan_correlated(subq, scope,
+                                                                 ctes)
+        outer_binder = _Binder(self, scope, ctes, outer=scope.parent)
+        lkeys = [outer_binder.bind(oe) for oe, _ in corr_pairs]
+        rkeys = list(inner_keys)
+        if in_expr is not None:
+            lkeys.append(outer_binder.bind(in_expr))
+            rkeys.append(P.BCol(sub_plan.out_dtypes[0], 0,
+                                sub_plan.out_names[0]))
+        if not lkeys:
+            raise PlanError("EXISTS subquery without correlation is unsupported")
+        kind = "anti" if negated else "semi"
+        # NOT IN (subquery) needs SQL null semantics; NOT EXISTS does not
+        null_aware = negated and in_expr is not None
+        return P.JoinNode(rel, sub_plan, kind, lkeys, rkeys, None,
+                          null_aware=null_aware,
+                          out_names=list(rel.out_names),
+                          out_dtypes=list(rel.out_dtypes))
+
+    def _decorrelate_scalars(self, rel, scope, node, ctes):
+        """Replace correlated scalar agg subqueries in `node` with columns
+        appended to `rel` via grouped left joins. Uncorrelated scalars stay as
+        runtime BScalarSubquery (handled by the binder)."""
+        rewritten: dict[int, P.BCol] = {}
+
+        subqs: list[A.ScalarSubquery] = []
+
+        def find(x):
+            if isinstance(x, A.ScalarSubquery):
+                subqs.append(x)
+                return
+            for ch in _children(x):
+                find(ch)
+        find(node)
+
+        cur = rel
+        for sq in subqs:
+            if not _is_correlated(sq.query, scope, self, ctes):
+                continue
+            derived, corr_pairs, inner_keys, value_dtype = \
+                self._plan_scalar_agg_subquery(sq.query, scope, ctes)
+            outer_binder = _Binder(self, scope, ctes, outer=scope.parent)
+            lkeys = [outer_binder.bind(oe) for oe, _ in corr_pairs]
+            width = len(cur.out_names)
+            cur = P.JoinNode(cur, derived, "left", lkeys, inner_keys, None,
+                             out_names=cur.out_names + derived.out_names,
+                             out_dtypes=cur.out_dtypes + derived.out_dtypes)
+            # value column is the last output of derived
+            value_idx = width + len(derived.out_names) - 1
+            rewritten[id(sq)] = P.BCol(value_dtype, value_idx, "__scalar")
+        # keep original entries (with qualifiers) and extend with joined cols
+        entries = list(scope.entries)
+        for i in range(len(scope.entries), len(cur.out_names)):
+            entries.append(ScopeEntry(None, cur.out_names[i],
+                                      cur.out_dtypes[i], i))
+        return cur, Scope(entries, parent=scope.parent), rewritten
+
+    def _plan_correlated(self, subq: A.Query, outer_scope, ctes):
+        """Plan an EXISTS/IN subquery body; extract equality correlations.
+
+        Returns (plan, [(outer_ast, inner_ast)], [bound inner key exprs]).
+        The plan outputs the subquery's select items first, then one column
+        per correlation key (so callers can use them as join keys).
+        """
+        if subq.ctes:
+            ctes = dict(ctes)
+            for nm, cq in subq.ctes:
+                ctes[nm] = self.plan_query(cq, outer=None, ctes=ctes)
+        body = subq.body
+        if not isinstance(body, A.Select):
+            raise PlanError("unsupported subquery form")
+        corr, inner_where = _extract_correlation(body.where, outer_scope, self,
+                                                 ctes, body)
+        inner_sel = replace(body, where=inner_where)
+        rel, inner_scope, _ = self._plan_from_where(inner_sel, None, ctes)
+        binder = _Binder(self, inner_scope, ctes, outer=None)
+        sel_exprs = []
+        for it in inner_sel.items:
+            if isinstance(it.expr, A.Star):
+                sel_exprs.append(P.BLit("int", 1))  # EXISTS (select *): row marker
+            else:
+                sel_exprs.append(binder.bind(it.expr))
+        extra_exprs = [binder.bind(ie) for _, ie in corr]
+        all_exprs = sel_exprs + extra_exprs
+        plan = P.ProjectNode(rel, all_exprs,
+                             out_names=[f"c{i}" for i in range(len(all_exprs))],
+                             out_dtypes=[e.dtype for e in all_exprs])
+        inner_keys = [P.BCol(e.dtype, len(sel_exprs) + i, f"k{i}")
+                      for i, e in enumerate(extra_exprs)]
+        return plan, corr, inner_keys
+
+    def _plan_scalar_agg_subquery(self, subq: A.Query, outer_scope, ctes):
+        """Decorrelate `(select AGG-expr from ... where corr-eqs and filters)`.
+
+        Returns (derived_plan, corr_pairs, inner_group_key_cols, value_dtype);
+        derived outputs [group keys..., value].
+        """
+        if subq.ctes:
+            ctes = dict(ctes)
+            for nm, cq in subq.ctes:
+                ctes[nm] = self.plan_query(cq, outer=None, ctes=ctes)
+        body = subq.body
+        if not isinstance(body, A.Select) or len(body.items) != 1:
+            raise PlanError("unsupported correlated scalar subquery")
+        corr, inner_where = _extract_correlation(body.where, outer_scope, self,
+                                                 ctes, body)
+        if not corr:
+            raise PlanError("scalar subquery marked correlated but no equality "
+                            "correlation found")
+        inner_sel = replace(body, where=inner_where)
+        rel, scope, _ = self._plan_from_where(inner_sel, None, ctes)
+        binder = _Binder(self, scope, ctes, outer=None)
+        group_exprs = [binder.bind(ie) for _, ie in corr]
+        agg_calls: list[A.FuncCall] = []
+        _collect_aggs(body.items[0].expr, agg_calls)
+        if not agg_calls:
+            raise PlanError("correlated scalar subquery must aggregate")
+        aggs = [self._make_aggspec(fc, binder) for fc in agg_calls]
+        agg_node = P.AggregateNode(
+            rel, group_exprs, aggs, False,
+            out_names=[f"g{i}" for i in range(len(group_exprs))] +
+                      [f"a{i}" for i in range(len(aggs))],
+            out_dtypes=[e.dtype for e in group_exprs] +
+                       [a.dtype for a in aggs])
+        # value expression over [group keys, agg results]
+        rewrites = {}
+        for i, fc in enumerate(agg_calls):
+            rewrites[_ast_key(fc)] = P.BCol(aggs[i].dtype,
+                                            len(group_exprs) + i, f"a{i}")
+        post_scope = Scope([ScopeEntry(None, n, d, i)
+                            for i, (n, d) in enumerate(zip(agg_node.out_names,
+                                                           agg_node.out_dtypes))])
+        post_binder = _Binder(self, post_scope, ctes, outer=None,
+                              rewrites=rewrites)
+        value = post_binder.bind(body.items[0].expr)
+        exprs = [P.BCol(e.dtype, i, f"g{i}") for i, e in enumerate(group_exprs)]
+        exprs.append(value)
+        derived = P.ProjectNode(
+            agg_node, exprs,
+            out_names=[f"g{i}" for i in range(len(group_exprs))] + ["__value"],
+            out_dtypes=[e.dtype for e in exprs])
+        inner_keys = [P.BCol(e.dtype, i, f"g{i}")
+                      for i, e in enumerate(group_exprs)]
+        return derived, corr, inner_keys, value.dtype
+
+    # -- aggregation ---------------------------------------------------------
+    def _make_aggspec(self, fc: A.FuncCall, binder) -> P.AggSpec:
+        func = fc.name
+        if func == "stddev":
+            func = "stddev_samp"
+        if func == "count" and fc.args and isinstance(fc.args[0], A.Star):
+            return P.AggSpec("count_star", None, False, "count(1)")
+        arg = binder.bind(fc.args[0]) if fc.args else None
+        return P.AggSpec(func, arg, fc.distinct, _display_name(fc))
+
+    def _plan_aggregate(self, rel, scope, sel, items, agg_calls, binder, ctes,
+                        outer):
+        group_asts = list(sel.group_by.exprs) if sel.group_by else []
+        rollup = bool(sel.group_by.rollup) if sel.group_by else False
+        # group by alias / ordinal -> replace with select expr
+        resolved_groups = []
+        for g in group_asts:
+            if isinstance(g, A.Literal) and isinstance(g.value, int):
+                resolved_groups.append(items[g.value - 1].expr)
+            elif isinstance(g, A.ColumnRef) and g.qualifier is None and \
+                    scope.resolve_local(g.name, None) is None:
+                hit = next((it.expr for it in items if it.alias == g.name), None)
+                resolved_groups.append(hit if hit is not None else g)
+            else:
+                resolved_groups.append(g)
+        group_bound = [binder.bind(g) for g in resolved_groups]
+        # dedupe agg calls by AST
+        uniq_aggs: list[A.FuncCall] = []
+        for fc in agg_calls:
+            if not any(fc == u for u in uniq_aggs):
+                uniq_aggs.append(fc)
+        aggs = [self._make_aggspec(fc, binder) for fc in uniq_aggs]
+        out_names = [_display_name(g) for g in resolved_groups] + \
+                    [a.name or a.func for a in aggs]
+        out_dtypes = [e.dtype for e in group_bound] + [a.dtype for a in aggs]
+        if rollup:
+            out_names.append("__grouping_id")
+            out_dtypes.append("int")
+        node = P.AggregateNode(rel, group_bound, aggs, rollup,
+                               out_names=out_names, out_dtypes=out_dtypes)
+        # rewrites: group ASTs and agg ASTs -> positional columns
+        rewrites: dict = {}
+        for i, g in enumerate(resolved_groups):
+            rewrites[_ast_key(g)] = P.BCol(group_bound[i].dtype, i,
+                                           out_names[i])
+        for i, fc in enumerate(uniq_aggs):
+            rewrites[_ast_key(fc)] = P.BCol(aggs[i].dtype,
+                                            len(group_bound) + i,
+                                            out_names[len(group_bound) + i])
+        new_entries = []
+        for i, g in enumerate(resolved_groups):
+            nm = g.name if isinstance(g, A.ColumnRef) else out_names[i]
+            qual = g.qualifier if isinstance(g, A.ColumnRef) else None
+            new_entries.append(ScopeEntry(qual, nm, group_bound[i].dtype, i))
+        for i in range(len(aggs)):
+            new_entries.append(ScopeEntry(None, out_names[len(group_bound) + i],
+                                          aggs[i].dtype, len(group_bound) + i))
+        if rollup:
+            new_entries.append(ScopeEntry(None, "__grouping_id", "int",
+                                          len(out_names) - 1))
+        new_scope = Scope(new_entries, parent=outer)
+        return node, new_scope, rewrites
+
+    # -- windows -------------------------------------------------------------
+    def _plan_windows(self, rel, scope, win_calls, binder, ctes, outer):
+        uniq: list[A.FuncCall] = []
+        for fc in win_calls:
+            if not any(fc == u for u in uniq):
+                uniq.append(fc)
+        funcs = []
+        for fc in uniq:
+            arg = None
+            if fc.args and not isinstance(fc.args[0], A.Star):
+                arg = binder.bind(fc.args[0])
+            func = fc.name
+            if func == "count" and fc.args and isinstance(fc.args[0], A.Star):
+                func = "count_star"
+            part = [binder.bind(e) for e in fc.over.partition_by]
+            okeys = [P.SortKey(binder.bind(si.expr), si.asc, si.nulls_first)
+                     for si in fc.over.order_by]
+            funcs.append(P.WindowFunc(func, arg, part, okeys,
+                                      name=_display_name(fc)))
+        out_names = list(rel.out_names) + [f.name for f in funcs]
+        out_dtypes = list(rel.out_dtypes) + [f.dtype for f in funcs]
+        node = P.WindowNode(rel, funcs, out_names=out_names,
+                            out_dtypes=out_dtypes)
+        rewrites = dict(binder.rewrites)
+        base = len(rel.out_names)
+        for i, fc in enumerate(uniq):
+            rewrites[_ast_key(fc)] = P.BCol(funcs[i].dtype, base + i,
+                                            funcs[i].name)
+        entries = list(scope.entries)
+        for i, f in enumerate(funcs):
+            entries.append(ScopeEntry(None, f.name, f.dtype, base + i))
+        new_scope = Scope(entries, parent=outer)
+        new_binder = _Binder(self, new_scope, ctes, outer=outer,
+                             rewrites=rewrites)
+        return node, new_scope, new_binder
+
+
+# ---------------------------------------------------------------------------
+# binder: AST expression -> bound expression
+# ---------------------------------------------------------------------------
+
+def _ast_key(node) -> str:
+    return repr(node)
+
+
+class _Binder:
+    def __init__(self, planner: Planner, scope: Scope, ctes,
+                 outer: Optional[Scope] = None, rewrites=None,
+                 subquery_cols=None, allow_outer: bool = True,
+                 num_group_cols: Optional[int] = None):
+        self.planner = planner
+        self.scope = scope
+        self.ctes = ctes
+        self.outer = outer
+        self.rewrites = rewrites or {}   # repr(ast) -> BCol
+        self.subquery_cols = subquery_cols or {}  # id(ScalarSubquery) -> BCol
+        self.allow_outer = allow_outer
+        self.num_group_cols = num_group_cols
+
+    def bind(self, node) -> P.BExpr:
+        key = _ast_key(node)
+        if key in self.rewrites:
+            return self.rewrites[key]
+        method = getattr(self, f"_bind_{type(node).__name__.lower()}", None)
+        if method is None:
+            raise PlanError(f"cannot bind {type(node).__name__}")
+        return method(node)
+
+    # -- leaves -------------------------------------------------------------
+    def _bind_literal(self, node: A.Literal) -> P.BExpr:
+        v = node.value
+        if node.type_hint == "date":
+            return P.BLit("date", _date_to_days(v))
+        if v is None:
+            return P.BLit("int", None)
+        if isinstance(v, bool):
+            return P.BLit("bool", v)
+        if isinstance(v, int):
+            return P.BLit("int", v)
+        if isinstance(v, float):
+            return P.BLit("float", v)
+        return P.BLit("str", v)
+
+    def _bind_columnref(self, node: A.ColumnRef) -> P.BExpr:
+        e = self.scope.resolve_local(node.name, node.qualifier)
+        if e is not None:
+            return P.BCol(e.dtype, e.index, e.name)
+        raise PlanError(f"cannot resolve column "
+                        f"{'.'.join(p for p in node.parts)}")
+
+    # -- operators ----------------------------------------------------------
+    _OPMAP = {"=": "eq", "<>": "ne", "<": "lt", "<=": "le", ">": "gt",
+              ">=": "ge", "+": "add", "-": "sub", "*": "mul", "/": "div",
+              "%": "mod", "and": "and", "or": "or", "||": "concat"}
+
+    def _bind_binop(self, node: A.BinOp) -> P.BExpr:
+        op = self._OPMAP[node.op]
+        # interval arithmetic folds/date ops
+        if op in ("add", "sub") and isinstance(node.right, A.Interval):
+            return self._bind_date_interval(node, op)
+        left = self.bind(node.left)
+        right = self.bind(node.right)
+        left, right = _coerce_pair(left, right)
+        if op in ("eq", "ne", "lt", "le", "gt", "ge"):
+            return P.BCall("bool", op, [left, right])
+        if op in ("and", "or"):
+            return P.BCall("bool", op, [left, right])
+        if op == "concat":
+            return P.BCall("str", "concat", _flatten_concat(left, right))
+        dtype = _arith_dtype(op, left, right)
+        return P.BCall(dtype, op, [left, right])
+
+    def _bind_date_interval(self, node: A.BinOp, op: str) -> P.BExpr:
+        base = self.bind(node.left)
+        iv = node.right
+        value = iv.value
+        if isinstance(value, A.Literal):
+            amount = int(value.value)
+        elif isinstance(value, A.UnaryOp) and isinstance(value.operand, A.Literal):
+            amount = -int(value.operand.value)
+        else:
+            raise PlanError("interval amount must be literal")
+        if op == "sub":
+            amount = -amount
+        if iv.unit == "day":
+            if isinstance(base, P.BLit):
+                return P.BLit("date", base.value + amount)
+            return P.BCall("date", "add", [base, P.BLit("int", amount)])
+        if iv.unit in ("month", "year"):
+            months = amount * (12 if iv.unit == "year" else 1)
+            if isinstance(base, P.BLit):
+                d = _dt.date(1970, 1, 1) + _dt.timedelta(days=base.value)
+                total = d.year * 12 + (d.month - 1) + months
+                y, m = divmod(total, 12)
+                day = min(d.day, _days_in_month(y, m + 1))
+                return P.BLit("date", _date_to_days(f"{y:04d}-{m+1:02d}-{day:02d}"))
+            raise PlanError("month/year interval on non-literal date")
+        raise PlanError(f"unsupported interval unit {iv.unit}")
+
+    def _bind_unaryop(self, node: A.UnaryOp) -> P.BExpr:
+        a = self.bind(node.operand)
+        if node.op == "not":
+            return P.BCall("bool", "not", [a])
+        if node.op == "-":
+            if isinstance(a, P.BLit) and a.value is not None:
+                return P.BLit(a.dtype, -a.value)
+            return P.BCall(a.dtype, "neg", [a])
+        return a
+
+    def _bind_between(self, node: A.Between) -> P.BExpr:
+        e = self.bind(node.expr)
+        lo = self.bind(node.low)
+        hi = self.bind(node.high)
+        e1, lo = _coerce_pair(e, lo)
+        e2, hi = _coerce_pair(e, hi)
+        ge = P.BCall("bool", "ge", [e1, lo])
+        le = P.BCall("bool", "le", [e2, hi])
+        both = P.BCall("bool", "and", [ge, le])
+        if node.negated:
+            return P.BCall("bool", "not", [both])
+        return both
+
+    def _bind_inlist(self, node: A.InList) -> P.BExpr:
+        e = self.bind(node.expr)
+        values = []
+        for item in node.items:
+            b = self.bind(item)
+            if not isinstance(b, P.BLit):
+                raise PlanError("IN list values must be literals")
+            v = b.value
+            if e.dtype == "date" and b.dtype == "str":
+                v = _date_to_days(v)
+            values.append(v)
+        call = P.BCall("bool", "in_list", [e], extra=values)
+        if node.negated:
+            return P.BCall("bool", "not", [call])
+        return call
+
+    def _bind_like(self, node: A.Like) -> P.BExpr:
+        e = self.bind(node.expr)
+        p = self.bind(node.pattern)
+        if not isinstance(p, P.BLit):
+            raise PlanError("LIKE pattern must be a literal")
+        call = P.BCall("bool", "like", [e], extra=p.value)
+        if node.negated:
+            return P.BCall("bool", "not", [call])
+        return call
+
+    def _bind_isnull(self, node: A.IsNull) -> P.BExpr:
+        e = self.bind(node.expr)
+        return P.BCall("bool", "isnotnull" if node.negated else "isnull", [e])
+
+    def _bind_case(self, node: A.Case) -> P.BExpr:
+        args = []
+        branches = []
+        for cond, val in node.whens:
+            if node.operand is not None:
+                cond = A.BinOp("=", node.operand, cond)
+            args.append(self.bind(cond))
+            branches.append(self.bind(val))
+        else_b = self.bind(node.else_) if node.else_ is not None \
+            else P.BLit("int", None)
+        dtype = _common_dtype([b.dtype for b in branches] + [else_b.dtype])
+        branches = [_coerce_to(b, dtype) for b in branches]
+        else_b = _coerce_to(else_b, dtype)
+        flat = []
+        for c, b in zip(args, branches):
+            flat += [c, b]
+        flat.append(else_b)
+        return P.BCall(dtype, "case", flat)
+
+    def _bind_cast(self, node: A.Cast) -> P.BExpr:
+        e = self.bind(node.expr)
+        t = node.to_type
+        if t.startswith("decimal") or t in ("double", "float", "real"):
+            target = "float"
+        elif t in ("int", "integer", "bigint", "long", "smallint", "tinyint"):
+            target = "int"
+        elif t == "date":
+            target = "date"
+        elif t in ("string", "varchar", "char") or t.startswith(("varchar", "char")):
+            target = "str"
+        else:
+            raise PlanError(f"unsupported cast target {t}")
+        if isinstance(e, P.BLit):
+            return _fold_cast_literal(e, target)
+        return P.BCall(target, "cast", [e])
+
+    def _bind_funccall(self, node: A.FuncCall) -> P.BExpr:
+        name = node.name
+        if node.over is not None:
+            raise PlanError(f"window function {name} outside window planning")
+        if name in _AGG_FUNCS or name in _WINDOW_ONLY:
+            raise PlanError(f"aggregate {name} in non-aggregate context")
+        args = [self.bind(a) for a in node.args]
+        if name in ("substr", "substring"):
+            start = args[1].value if isinstance(args[1], P.BLit) else None
+            length = args[2].value if len(args) > 2 and \
+                isinstance(args[2], P.BLit) else None
+            if start is None:
+                raise PlanError("substr start must be literal")
+            return P.BCall("str", "substr", [args[0]], extra=(start, length))
+        if name == "coalesce":
+            dtype = _common_dtype([a.dtype for a in args])
+            return P.BCall(dtype, "coalesce",
+                           [_coerce_to(a, dtype) for a in args])
+        if name == "abs":
+            return P.BCall(args[0].dtype, "abs", args)
+        if name == "round":
+            digits = args[1].value if len(args) > 1 and \
+                isinstance(args[1], P.BLit) else 0
+            return P.BCall("float", "round", [args[0]], extra=digits)
+        if name == "nullif":
+            return P.BCall(args[0].dtype, "nullif", args)
+        if name == "grouping":
+            e = self.scope.resolve_local("__grouping_id", None)
+            if e is None or self.num_group_cols is None:
+                raise PlanError("grouping() outside rollup aggregation")
+            target = self.rewrites.get(_ast_key(node.args[0]))
+            if target is None:
+                raise PlanError("grouping() argument is not a group expression")
+            gid_col = P.BCol("int", e.index, "__grouping_id")
+            # Spark convention: bit 0 is the LAST group expression
+            bit = self.num_group_cols - 1 - target.index
+            return P.BCall("int", "grouping_bit", [gid_col], extra=bit)
+        if name == "concat":
+            return P.BCall("str", "concat", args)
+        raise PlanError(f"unsupported function {name}")
+
+    def _bind_scalarsubquery(self, node: A.ScalarSubquery) -> P.BExpr:
+        if id(node) in self.subquery_cols:
+            return self.subquery_cols[id(node)]
+        plan = self.planner.plan_query(node.query, outer=None, ctes=self.ctes)
+        if len(plan.out_dtypes) != 1:
+            raise PlanError("scalar subquery must return one column")
+        return P.BScalarSubquery(plan.out_dtypes[0], plan)
+
+    def _bind_exists(self, node: A.Exists):
+        raise PlanError("EXISTS is only supported as a WHERE conjunct")
+
+    def _bind_insubquery(self, node: A.InSubquery):
+        raise PlanError("IN <subquery> is only supported as a WHERE conjunct")
+
+    def _bind_star(self, node: A.Star):
+        raise PlanError("* outside SELECT list")
+
+    def _bind_interval(self, node: A.Interval):
+        raise PlanError("interval literal outside +/- expression")
+
+
+# ---------------------------------------------------------------------------
+# small AST utilities
+# ---------------------------------------------------------------------------
+
+def _children(node):
+    if isinstance(node, A.BinOp):
+        return (node.left, node.right)
+    if isinstance(node, A.UnaryOp):
+        return (node.operand,)
+    if isinstance(node, A.FuncCall):
+        extra = []
+        if node.over is not None:
+            extra = list(node.over.partition_by) + \
+                [si.expr for si in node.over.order_by]
+        return tuple(node.args) + tuple(extra)
+    if isinstance(node, A.Case):
+        out = []
+        if node.operand is not None:
+            out.append(node.operand)
+        for c, v in node.whens:
+            out += [c, v]
+        if node.else_ is not None:
+            out.append(node.else_)
+        return tuple(out)
+    if isinstance(node, A.Cast):
+        return (node.expr,)
+    if isinstance(node, A.Between):
+        return (node.expr, node.low, node.high)
+    if isinstance(node, A.InList):
+        return (node.expr, *node.items)
+    if isinstance(node, A.InSubquery):
+        return (node.expr,)
+    if isinstance(node, A.Like):
+        return (node.expr, node.pattern)
+    if isinstance(node, A.IsNull):
+        return (node.expr,)
+    if isinstance(node, A.Interval):
+        return (node.value,)
+    return ()
+
+
+def _split_and(node) -> list:
+    if isinstance(node, A.BinOp) and node.op == "and":
+        return _split_and(node.left) + _split_and(node.right)
+    return [node]
+
+
+def _and_all(parts):
+    if not parts:
+        return None
+    out = parts[0]
+    for p in parts[1:]:
+        out = P.BCall("bool", "and", [out, p])
+    return out
+
+
+def _has_subquery(node) -> bool:
+    if isinstance(node, (A.ScalarSubquery, A.InSubquery, A.Exists)):
+        return True
+    return any(_has_subquery(c) for c in _children(node))
+
+
+def _collect_aggs(node, out: list):
+    if isinstance(node, A.FuncCall):
+        if node.over is not None:
+            # window call itself is not an aggregate, but aggregates may
+            # appear inside its args / PARTITION BY / ORDER BY (rank over sum)
+            for c in _children(node):
+                _collect_aggs(c, out)
+            return
+        if node.name in _AGG_FUNCS:
+            out.append(node)
+            return
+    if isinstance(node, (A.ScalarSubquery, A.InSubquery, A.Exists)):
+        return
+    for c in _children(node):
+        _collect_aggs(c, out)
+
+
+def _collect_windows(node, out: list):
+    if isinstance(node, A.FuncCall) and node.over is not None:
+        out.append(node)
+        return
+    for c in _children(node):
+        _collect_windows(c, out)
+
+
+def _is_correlated(q: A.Query, outer_scope: Scope, planner, ctes) -> bool:
+    """Does the subquery's WHERE reference a column only the outer resolves?"""
+    body = q.body
+    if not (isinstance(body, A.Select) and body.where is not None):
+        return False
+    inner_quals = _relation_aliases(body)
+    inner_cols = _inner_columns(body, planner, ctes)
+    found = [False]
+
+    def visit(x):
+        if isinstance(x, A.ColumnRef):
+            if x.qualifier is not None:
+                if x.qualifier not in inner_quals and \
+                        outer_scope.resolve_local(x.name, x.qualifier) is not None:
+                    found[0] = True
+            elif x.name not in inner_cols and \
+                    outer_scope.resolve_local(x.name, None) is not None:
+                found[0] = True
+        for c in _children(x):
+            visit(c)
+    visit(body.where)
+    return found[0]
+
+
+def _inner_columns(sel: A.Select, planner, ctes) -> set:
+    """Column names visible from the subquery's own FROM relations."""
+    cols: set = set()
+
+    def visit(n):
+        if isinstance(n, A.TableRef):
+            if n.name in ctes:
+                cols.update(ctes[n.name].out_names)
+            else:
+                try:
+                    names, _ = planner.catalog.schema(n.name)
+                    cols.update(names)
+                except PlanError:
+                    pass
+        elif isinstance(n, A.SubqueryRef):
+            pass  # alias-qualified access only; unqualified matches are rare
+        elif isinstance(n, A.Join):
+            visit(n.left)
+            visit(n.right)
+    if sel.from_ is not None:
+        visit(sel.from_)
+    return cols
+
+
+def _relation_aliases(sel: A.Select) -> set:
+    out = set()
+
+    def visit(n):
+        if isinstance(n, A.TableRef):
+            out.add(n.alias or n.name)
+        elif isinstance(n, A.SubqueryRef):
+            out.add(n.alias)
+        elif isinstance(n, A.Join):
+            visit(n.left)
+            visit(n.right)
+    if sel.from_ is not None:
+        visit(sel.from_)
+    return out
+
+
+def _extract_correlation(where, outer_scope, planner, ctes, inner_sel):
+    """Split subquery WHERE into correlation equality pairs and inner-only rest.
+
+    Returns ([(outer_ast, inner_ast)], remaining_where_ast).
+    """
+    if where is None:
+        return [], None
+    inner_quals = _relation_aliases(inner_sel)
+    inner_cols = _inner_columns(inner_sel, planner, ctes)
+
+    def side_is_outer(x) -> Optional[bool]:
+        """True if expr references outer scope, False if inner, None if unclear."""
+        verdict = []
+
+        def visit(y):
+            if isinstance(y, A.ColumnRef):
+                if y.qualifier is not None:
+                    if y.qualifier in inner_quals:
+                        verdict.append(False)
+                    elif outer_scope.resolve_local(y.name, y.qualifier) is not None:
+                        verdict.append(True)
+                    else:
+                        verdict.append(False)
+                else:
+                    if y.name in inner_cols:
+                        verdict.append(False)
+                    elif outer_scope.resolve_local(y.name, None) is not None:
+                        verdict.append(True)
+                    else:
+                        verdict.append(False)
+            for c in _children(y):
+                visit(c)
+        visit(x)
+        if not verdict:
+            return None
+        if all(verdict):
+            return True
+        if not any(verdict):
+            return False
+        return None
+
+    corr = []
+    rest = []
+    for c in _split_and(where):
+        if isinstance(c, A.BinOp) and c.op == "=":
+            ls, rs = side_is_outer(c.left), side_is_outer(c.right)
+            if ls is True and rs is False:
+                corr.append((c.left, c.right))
+                continue
+            if ls is False and rs is True:
+                corr.append((c.right, c.left))
+                continue
+        rest.append(c)
+    remaining = None
+    for c in rest:
+        remaining = c if remaining is None else A.BinOp("and", remaining, c)
+    return corr, remaining
+
+
+# -- dtype coercion ----------------------------------------------------------
+
+def _common_dtype(dtypes: list[str]) -> str:
+    s = set(dtypes)
+    if "str" in s and s - {"str"}:
+        non_null = s - {"str"}
+        # NULL literals bind as int; treat mixed str/int-null as str
+        if non_null <= {"int"}:
+            return "str"
+    if len(s) == 1:
+        return next(iter(s))
+    if s <= {"int", "float"}:
+        return "float"
+    if s <= {"int", "date"}:
+        return "date"
+    if s <= {"int", "bool"}:
+        return "bool"
+    if s <= {"int", "str"}:
+        return "str"
+    if s <= {"int", "float", "date"}:
+        return "float"
+    raise PlanError(f"no common type for {sorted(s)}")
+
+
+def _coerce_to(e: P.BExpr, dtype: str) -> P.BExpr:
+    if e.dtype == dtype:
+        return e
+    if isinstance(e, P.BLit):
+        if e.value is None:
+            return P.BLit(dtype, None)
+        return _fold_cast_literal(e, dtype)
+    return P.BCall(dtype, "cast", [e])
+
+
+def _fold_cast_literal(e: P.BLit, target: str) -> P.BLit:
+    v = e.value
+    if v is None:
+        return P.BLit(target, None)
+    if target == "date" and isinstance(v, str):
+        return P.BLit("date", _date_to_days(v))
+    if target == "float":
+        return P.BLit("float", float(v))
+    if target == "int":
+        return P.BLit("int", int(v))
+    if target == "str":
+        return P.BLit("str", str(v))
+    return P.BLit(target, v)
+
+
+def _coerce_pair(a: P.BExpr, b: P.BExpr) -> tuple[P.BExpr, P.BExpr]:
+    if a.dtype == b.dtype:
+        return a, b
+    # date vs string literal
+    if a.dtype == "date" and isinstance(b, P.BLit) and b.dtype == "str":
+        return a, P.BLit("date", _date_to_days(b.value))
+    if b.dtype == "date" and isinstance(a, P.BLit) and a.dtype == "str":
+        return P.BLit("date", _date_to_days(a.value)), b
+    # numeric widening
+    if {a.dtype, b.dtype} <= {"int", "float"}:
+        return _coerce_to(a, "float"), _coerce_to(b, "float")
+    if {a.dtype, b.dtype} <= {"int", "date"}:
+        return a, b  # date arithmetic/comparison on day numbers
+    # string vs numeric literal comparisons: cast literal to string
+    if a.dtype == "str" and isinstance(b, P.BLit):
+        return a, P.BLit("str", str(b.value))
+    if b.dtype == "str" and isinstance(a, P.BLit):
+        return P.BLit("str", str(a.value)), b
+    # string column vs numeric column: cast string to float
+    if a.dtype == "str":
+        return P.BCall("float", "cast", [a]), _coerce_to(b, "float")
+    if b.dtype == "str":
+        return _coerce_to(a, "float"), P.BCall("float", "cast", [b])
+    return a, b
+
+
+def _arith_dtype(op: str, a: P.BExpr, b: P.BExpr) -> str:
+    if op == "div":
+        return "float"
+    if a.dtype == "date" or b.dtype == "date":
+        # date +/- int -> date; date - date -> int
+        if a.dtype == "date" and b.dtype == "date":
+            return "int"
+        return "date"
+    if a.dtype == "float" or b.dtype == "float":
+        return "float"
+    return "int"
+
+
+def _flatten_concat(left: P.BExpr, right: P.BExpr) -> list[P.BExpr]:
+    parts = []
+    for e in (left, right):
+        if isinstance(e, P.BCall) and e.op == "concat":
+            parts.extend(e.args)
+        else:
+            parts.append(e)
+    return parts
+
+
+def _col_indices(e: P.BExpr) -> list[int]:
+    out = []
+
+    def visit(x):
+        if isinstance(x, P.BCol):
+            out.append(x.index)
+        if isinstance(x, P.BCall):
+            for a in x.args:
+                visit(a)
+    visit(e)
+    return out
+
+
+def _shift(e: P.BExpr, delta: int) -> P.BExpr:
+    if isinstance(e, P.BCol):
+        return P.BCol(e.dtype, e.index + delta, e.name)
+    if isinstance(e, P.BCall):
+        return P.BCall(e.dtype, e.op, [_shift(a, delta) for a in e.args],
+                       e.extra)
+    return e
+
+
+def _display_name(node) -> str:
+    if isinstance(node, A.ColumnRef):
+        return node.name
+    if isinstance(node, A.FuncCall):
+        inner = ", ".join(_display_name(a) for a in node.args) if node.args else ""
+        if node.args and isinstance(node.args[0], A.Star):
+            inner = "*"
+        return f"{node.name}({inner})"
+    if isinstance(node, A.Star):
+        return "*"
+    if isinstance(node, A.Literal):
+        return str(node.value)
+    if isinstance(node, A.BinOp):
+        return f"({_display_name(node.left)} {node.op} {_display_name(node.right)})"
+    if isinstance(node, A.Case):
+        return "case"
+    if isinstance(node, A.Cast):
+        return _display_name(node.expr)
+    return type(node).__name__.lower()

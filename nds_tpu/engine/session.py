@@ -1,0 +1,115 @@
+"""Session: the user-facing entry point of the SQL engine.
+
+Plays the role SparkSession plays in the reference's workload jobs
+(reference nds_power.py:221-245 builds the session and registers temp views;
+run_one_query at :124-134 is `spark.sql(q).collect()`). Here tables register
+from Arrow/Parquet and `sql()` parses, plans, and executes on the JAX engine.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import pyarrow as pa
+import pyarrow.dataset as pa_dataset
+
+from ..config import EngineConfig
+from ..sql import parse_sql
+from .column import Table
+from .executor import Executor
+from .planner import Catalog, Planner
+from . import arrow_bridge
+
+
+class Session:
+    def __init__(self, config: Optional[EngineConfig] = None):
+        self.config = config or EngineConfig()
+        self._loaders: dict[str, Callable[[], Table]] = {}
+        self._schemas: dict[str, tuple[list[str], list[str]]] = {}
+        self._est_rows: dict[str, int] = {}
+        self._cache: dict[str, Table] = {}
+
+    # -- registration -------------------------------------------------------
+    def register_arrow(self, name: str, table: pa.Table,
+                       est_rows: Optional[int] = None) -> None:
+        names, dtypes = arrow_bridge.engine_schema(table.schema)
+        self._schemas[name] = (names, dtypes)
+        self._est_rows[name] = est_rows if est_rows is not None else table.num_rows
+        self._loaders[name] = lambda t=table: arrow_bridge.from_arrow(t)
+        self._cache.pop(name, None)
+
+    def register_parquet(self, name: str, path: str,
+                         est_rows: Optional[int] = None) -> None:
+        """Register a parquet file or partitioned directory as a table."""
+        dataset = pa_dataset.dataset(path, format="parquet",
+                                     partitioning="hive")
+        schema = dataset.schema
+        names, dtypes = arrow_bridge.engine_schema(schema)
+        self._schemas[name] = (names, dtypes)
+        if est_rows is None:
+            est_rows = dataset.count_rows()
+        self._est_rows[name] = est_rows
+
+        def load(ds=dataset):
+            return arrow_bridge.from_arrow(ds.to_table())
+        self._loaders[name] = load
+        self._cache.pop(name, None)
+
+    def register_view(self, name: str, table: Table,
+                      dtypes: Optional[list[str]] = None) -> None:
+        """Register an engine Table (e.g. a temp view) directly."""
+        dts = dtypes or [c.dtype for c in table.columns]
+        self._schemas[name] = (list(table.names), dts)
+        self._est_rows[name] = table.num_rows
+        self._loaders[name] = lambda t=table: t
+        self._cache[name] = table
+
+    def drop(self, name: str) -> None:
+        self._schemas.pop(name, None)
+        self._loaders.pop(name, None)
+        self._cache.pop(name, None)
+        self._est_rows.pop(name, None)
+
+    def table_names(self) -> list[str]:
+        return list(self._schemas)
+
+    def load_table(self, name: str) -> Table:
+        if name not in self._cache:
+            self._cache[name] = self._loaders[name]()
+        return self._cache[name]
+
+    # -- query --------------------------------------------------------------
+    def _catalog(self) -> Catalog:
+        return Catalog({name: (sch[0], sch[1], self._est_rows.get(name, 1000))
+                        for name, sch in self._schemas.items()})
+
+    def sql(self, query: str) -> Table:
+        ast = parse_sql(query)
+        planner = Planner(self._catalog())
+        plan = planner.plan_query(ast)
+        executor = Executor(self.load_table)
+        return executor.execute(plan)
+
+    def sql_arrow(self, query: str) -> pa.Table:
+        return arrow_bridge.to_arrow(self.sql(query))
+
+    def explain(self, query: str) -> str:
+        ast = parse_sql(query)
+        planner = Planner(self._catalog())
+        plan = planner.plan_query(ast)
+        lines: list[str] = []
+
+        def render(node, depth):
+            label = type(node).__name__.replace("Node", "")
+            detail = ""
+            if hasattr(node, "table"):
+                detail = f" {getattr(node, 'table', '')}"
+            if hasattr(node, "kind"):
+                detail = f" [{node.kind}]"
+            lines.append("  " * depth + f"{label}{detail}"
+                         f" -> {len(node.out_names)} cols")
+            for f in ("child", "left", "right"):
+                sub = getattr(node, f, None)
+                if sub is not None and hasattr(sub, "out_names"):
+                    render(sub, depth + 1)
+        render(plan, 0)
+        return "\n".join(lines)

@@ -1,0 +1,258 @@
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from nds_tpu.engine import Session
+
+
+@pytest.fixture()
+def s():
+    s = Session()
+    s.register_arrow("t", pa.table({
+        "a": [1, 2, 3, 4, None],
+        "b": [10.0, 20.0, 30.0, 40.0, 50.0],
+        "g": ["x", "y", "x", "y", "x"],
+        "d": pa.array([0, 1, 2, 3, 4], type=pa.int64()),
+    }))
+    s.register_arrow("u", pa.table({"k": [1, 2, 6], "v": ["one", "two", "six"]}))
+    s.register_arrow("dates", pa.table({
+        "dk": [1, 2, 3],
+        "dt": pa.array(["2000-01-01", "2000-01-15", "2000-03-01"]).cast(pa.date32()),
+    }))
+    return s
+
+
+def test_filter_and_order(s):
+    assert s.sql("select a from t where a > 1 order by a desc").to_pylist() == \
+        [(4,), (3,), (2,)]
+
+
+def test_null_comparison_excluded(s):
+    # NULL > 1 is unknown -> filtered out
+    assert len(s.sql("select a from t where a > 0").to_pylist()) == 4
+
+
+def test_three_valued_or(s):
+    # a > 3 OR a IS NULL keeps the null row via IS NULL
+    rows = s.sql("select a from t where a > 3 or a is null").to_pylist()
+    assert rows == [(4,), (None,)]
+
+
+def test_group_by(s):
+    rows = s.sql("select g, sum(b), count(*), count(a), avg(a) "
+                 "from t group by g order by g").to_pylist()
+    assert rows == [("x", 90.0, 3, 2, 2.0), ("y", 60.0, 2, 2, 3.0)]
+
+
+def test_count_distinct(s):
+    rows = s.sql("select count(distinct g), count(distinct a) from t "
+                 "group by 1=1" if False else
+                 "select g, count(distinct g) from t group by g").to_pylist()
+    assert rows == [("x", 1), ("y", 1)]
+
+
+def test_global_aggregate(s):
+    rows = s.sql("select sum(b), min(a), max(a), count(*) from t").to_pylist()
+    assert rows == [(150.0, 1, 4, 5)]
+
+
+def test_having(s):
+    rows = s.sql("select g, sum(b) from t group by g "
+                 "having sum(b) > 70 order by g").to_pylist()
+    assert rows == [("x", 90.0)]
+
+
+def test_inner_join(s):
+    rows = s.sql("select a, v from t join u on t.a = u.k order by a").to_pylist()
+    assert rows == [(1, "one"), (2, "two")]
+
+
+def test_left_join_nulls(s):
+    rows = s.sql("select a, v from t left join u on t.a = u.k "
+                 "order by a nulls last").to_pylist()
+    assert rows == [(1, "one"), (2, "two"), (3, None), (4, None), (None, None)]
+
+
+def test_comma_join_with_where(s):
+    rows = s.sql("select a, v from t, u where t.a = u.k order by a").to_pylist()
+    assert rows == [(1, "one"), (2, "two")]
+
+
+def test_semi_join_in_subquery(s):
+    rows = s.sql("select a from t where a in (select k from u)").to_pylist()
+    assert sorted(rows) == [(1,), (2,)]
+
+
+def test_anti_join_not_exists(s):
+    rows = s.sql("select a from t where not exists "
+                 "(select 1 from u where u.k = t.a) and a is not null "
+                 "order by a").to_pylist()
+    assert rows == [(3,), (4,)]
+
+
+def test_uncorrelated_scalar_subquery(s):
+    # Spark default ordering: ASC => NULLS FIRST
+    rows = s.sql("select a from t where b > (select avg(b) from t) "
+                 "order by a").to_pylist()
+    assert rows == [(None,), (4,)]
+
+
+def test_correlated_scalar_subquery(s):
+    rows = s.sql(
+        "select g, b from t t1 where b > (select avg(b) from t t2 "
+        "where t1.g = t2.g) order by g").to_pylist()
+    assert rows == [("x", 50.0), ("y", 40.0)]
+
+
+def test_window_rank(s):
+    rows = s.sql("select g, b, rank() over (partition by g order by b desc) rk "
+                 "from t order by g, rk").to_pylist()
+    assert rows == [("x", 50.0, 1), ("x", 30.0, 2), ("x", 10.0, 3),
+                    ("y", 40.0, 1), ("y", 20.0, 2)]
+
+
+def test_window_running_sum(s):
+    rows = s.sql("select d, sum(b) over (order by d) rs from t order by d").to_pylist()
+    assert [r[1] for r in rows] == [10.0, 30.0, 60.0, 100.0, 150.0]
+
+
+def test_window_whole_partition_avg(s):
+    rows = s.sql("select g, avg(b) over (partition by g) ab from t "
+                 "order by g, ab").to_pylist()
+    assert rows[0] == ("x", 30.0) and rows[-1] == ("y", 30.0)
+
+
+def test_distinct(s):
+    assert s.sql("select distinct g from t order by g").to_pylist() == \
+        [("x",), ("y",)]
+
+
+def test_union_and_intersect(s):
+    rows = s.sql("select k from u union select a from t where a is not null "
+                 "order by k").to_pylist()
+    assert rows == [(1,), (2,), (3,), (4,), (6,)]
+    rows = s.sql("select k from u intersect select a from t").to_pylist()
+    assert sorted(rows) == [(1,), (2,)]
+    rows = s.sql("select k from u except select a from t").to_pylist()
+    assert rows == [(6,)]
+
+
+def test_rollup_grouping(s):
+    rows = s.sql("select g, grouping(g) gg, sum(b) from t group by rollup(g) "
+                 "order by gg, g").to_pylist()
+    assert rows == [("x", 0, 90.0), ("y", 0, 60.0), (None, 1, 150.0)]
+
+
+def test_case_when(s):
+    rows = s.sql("select case when a > 2 then 'big' when a is null then 'nul' "
+                 "else 'small' end c, b from t order by b").to_pylist()
+    assert [r[0] for r in rows] == ["small", "small", "big", "big", "nul"]
+
+
+def test_like_and_substr(s):
+    rows = s.sql("select v from u where v like 'o%'").to_pylist()
+    assert rows == [("one",)]
+    rows = s.sql("select substr(v, 1, 2) from u order by v").to_pylist()
+    assert rows == [("on",), ("si",), ("tw",)]
+
+
+def test_concat(s):
+    rows = s.sql("select g || '-' || v from t join u on t.a = u.k "
+                 "order by a").to_pylist()
+    assert rows == [("x-one",), ("y-two",)]
+
+
+def test_cast_and_arith(s):
+    rows = s.sql("select cast(b as int), a * 2 + 1, b / 4 from t "
+                 "where a = 2").to_pylist()
+    assert rows == [(20, 5, 5.0)]
+
+
+def test_div_by_zero_is_null(s):
+    rows = s.sql("select b / (a - 2) from t where a = 2").to_pylist()
+    assert rows == [(None,)]
+
+
+def test_date_literals_and_interval(s):
+    rows = s.sql("select dk from dates where dt between '2000-01-01' and "
+                 "cast('2000-01-01' as date) + interval 20 days "
+                 "order by dk").to_pylist()
+    assert rows == [(1,), (2,)]
+    rows = s.sql("select dk from dates where dt >= date '2000-02-01'").to_pylist()
+    assert rows == [(3,)]
+
+
+def test_in_list(s):
+    rows = s.sql("select a from t where g in ('y') order by a").to_pylist()
+    assert rows == [(2,), (4,)]
+
+
+def test_limit(s):
+    assert len(s.sql("select a from t order by b limit 2").to_pylist()) == 2
+
+
+def test_order_by_alias_and_ordinal(s):
+    rows = s.sql("select g, sum(b) total from t group by g order by total desc")
+    assert rows.to_pylist()[0][0] == "x"
+    rows = s.sql("select g, sum(b) from t group by g order by 2")
+    assert rows.to_pylist()[0][0] == "y"
+
+
+def test_select_star(s):
+    rows = s.sql("select * from u order by k").to_pylist()
+    assert rows[0] == (1, "one")
+
+
+def test_subquery_in_from(s):
+    rows = s.sql("select gg, tot from (select g gg, sum(b) tot from t group by g) "
+                 "sub where tot > 70").to_pylist()
+    assert rows == [("x", 90.0)]
+
+
+def test_self_join(s):
+    rows = s.sql("select t1.a, t2.a from t t1, t t2 "
+                 "where t1.a = t2.a and t1.a < 3 order by t1.a").to_pylist()
+    assert rows == [(1, 1), (2, 2)]
+
+
+def test_cte_reuse(s):
+    rows = s.sql(
+        "with c as (select g, sum(b) tot from t group by g) "
+        "select c1.g from c c1, c c2 where c1.tot > c2.tot").to_pylist()
+    assert rows == [("x",)]
+
+
+def test_stddev(s):
+    rows = s.sql("select stddev_samp(b) from t").to_pylist()
+    assert abs(rows[0][0] - np.std([10, 20, 30, 40, 50], ddof=1)) < 1e-9
+
+
+def test_sum_over_empty_group_is_absent(s):
+    rows = s.sql("select g, sum(b) from t where a > 100 group by g").to_pylist()
+    assert rows == []
+
+
+def test_global_agg_on_empty_input(s):
+    rows = s.sql("select count(*), sum(b) from t where a > 100").to_pylist()
+    assert rows == [(0, None)]
+
+
+def test_windowed_count_star_running(s):
+    rows = s.sql("select a, count(*) over (order by a) c from t "
+                 "where a is not null order by a").to_pylist()
+    assert [r[1] for r in rows] == [1, 2, 3, 4]
+
+
+def test_rank_over_window_only_aggregate(s):
+    rows = s.sql("select g, rank() over (order by sum(b) desc) r "
+                 "from t group by g order by r").to_pylist()
+    assert rows == [("x", 1), ("y", 2)]
+
+
+def test_not_in_null_semantics(s):
+    s.register_arrow("nn", __import__("pyarrow").table(
+        {"k": [1, None]}))
+    assert s.sql("select a from t where a not in (select k from nn)"
+                 ).to_pylist() == []
+    assert s.sql("select a from t where a not in (1, null)").to_pylist() == []
+    assert s.sql("select a from t where a in (1, null)").to_pylist() == [(1,)]

@@ -1,0 +1,8 @@
+"""JAX/XLA execution backend: the TPU compute path of the engine.
+
+Padded static-shape columnar kernels (kernels.py), device expression
+evaluation with host-dictionary string LUTs (jexprs.py), and a plan executor
+with per-node fallback to the numpy oracle backend (executor.py).
+"""
+from .device import DCol, DTable, to_device, to_host, bucket  # noqa: F401
+from .executor import JaxExecutor  # noqa: F401

@@ -1,0 +1,161 @@
+"""Device-resident columnar data for the JAX execution backend.
+
+Static-shape discipline (the XLA contract): every table lives in a padded
+buffer of `capacity` rows with an `alive` row mask; relational ops never
+change capacity mid-kernel, so each kernel compiles once per shape bucket.
+Strings are dictionary codes (int32) on device; dictionaries stay on the
+host and string compute happens on the dictionary (trace-time LUTs).
+
+This is the TPU analog of the reference's cuDF columns on GPU (reference
+nds/nds_transcode.py + RAPIDS plugin do columnar compute on device; here
+the columnar compute is XLA programs over padded arrays).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..column import Column, Table
+
+_NULL_CODE = -1
+
+
+def bucket(n: int, minimum: int = 8) -> int:
+    """Round a row count up to the next power of two (compile-cache friendly)."""
+    c = max(int(n), minimum)
+    return 1 << (c - 1).bit_length()
+
+
+def phys_dtype(logical: str):
+    x64 = jax.config.read("jax_enable_x64")
+    return {
+        "int": jnp.int64 if x64 else jnp.int32,
+        "float": jnp.float64 if x64 else jnp.float32,
+        "bool": jnp.bool_,
+        "date": jnp.int32,
+        "str": jnp.int32,
+    }[logical]
+
+
+@dataclass
+class DCol:
+    """A device column: padded values + always-materialized validity mask.
+
+    Invariant: slots that are null (or dead rows) hold canonical zeros so
+    grouping/sorting kernels see deterministic payloads.
+    """
+    dtype: str                 # logical: int | float | bool | date | str
+    data: jax.Array
+    valid: jax.Array           # bool, same length
+    dictionary: Optional[np.ndarray] = None  # host object array for "str"
+    parts: Optional[tuple] = None  # compound string: tuple[DCol] (lazy concat)
+
+    def __len__(self) -> int:
+        return int(self.data.shape[0])
+
+    def canon(self) -> "DCol":
+        zero = jnp.zeros((), dtype=self.data.dtype)
+        return replace(self, data=jnp.where(self.valid, self.data, zero))
+
+
+@dataclass
+class DTable:
+    names: list[str]
+    cols: list[DCol]
+    alive: jax.Array           # bool row mask, length == capacity
+
+    @property
+    def capacity(self) -> int:
+        return int(self.alive.shape[0])
+
+    def count(self) -> jax.Array:
+        return jnp.sum(self.alive.astype(jnp.int32))
+
+
+# -- host <-> device bridging ------------------------------------------------
+
+def to_device(table: Table, capacity: Optional[int] = None) -> DTable:
+    n = table.num_rows
+    cap = capacity if capacity is not None else bucket(n)
+    cols = []
+    for c in table.columns:
+        data = np.asarray(c.data)
+        dt = phys_dtype(c.dtype)
+        buf = np.zeros(cap, dtype=np.dtype(dt))
+        v = np.zeros(cap, dtype=bool)
+        v[:n] = c.validity
+        buf[:n] = np.where(c.validity, data, 0)
+        if c.dtype == "str":
+            # canonical null slot for codes is 0 (valid=False marks them)
+            buf[:n] = np.where(c.validity & (data >= 0), data, 0)
+        cols.append(DCol(c.dtype, jnp.asarray(buf), jnp.asarray(v), c.dictionary))
+    alive = np.zeros(cap, dtype=bool)
+    alive[:n] = True
+    return DTable(list(table.names), cols, jnp.asarray(alive))
+
+
+def to_host(dt: DTable, count: Optional[int] = None) -> Table:
+    """Materialize a device table back into a host Table (compacted)."""
+    alive = np.asarray(dt.alive)
+    idx = np.flatnonzero(alive)
+    if count is not None:
+        idx = idx[:count]
+    cols = []
+    for c in dt.cols:
+        c = _flatten_compound(c)
+        data = np.asarray(c.data)[idx]
+        valid = np.asarray(c.valid)[idx]
+        if c.dtype == "str":
+            data = np.where(valid, data, _NULL_CODE).astype(np.int32)
+        host_dtype = {"int": np.int64, "float": np.float64, "bool": np.bool_,
+                      "date": np.int32, "str": np.int32}[c.dtype]
+        cols.append(Column(c.dtype, data.astype(host_dtype),
+                           None if bool(valid.all()) else valid, c.dictionary))
+    return Table(list(dt.names), cols)
+
+
+def _flatten_compound(c: DCol) -> DCol:
+    """Materialize a lazy-concat compound string column into a real dictionary."""
+    if c.parts is None:
+        return c
+    part_strs = []
+    for p in c.parts:
+        codes = np.asarray(p.data)
+        valid = np.asarray(p.valid)
+        d = p.dictionary if p.dictionary is not None else np.empty(0, dtype=object)
+        safe = np.clip(codes, 0, max(len(d) - 1, 0))
+        vals = d[safe] if len(d) else np.full(len(codes), "", dtype=object)
+        part_strs.append(np.where(valid, vals, ""))
+    joined = part_strs[0].astype(object)
+    for p in part_strs[1:]:
+        joined = np.asarray([a + b for a, b in zip(joined, p.astype(object))],
+                            dtype=object)
+    uniq, codes = np.unique(joined.astype(str), return_inverse=True)
+    return DCol("str", jnp.asarray(codes.astype(np.int32)), c.valid,
+                uniq.astype(object))
+
+
+def string_rank_lut(dictionary: Optional[np.ndarray]) -> np.ndarray:
+    """Host LUT: dictionary code -> lexicographic rank (for device sort/compare)."""
+    if dictionary is None or len(dictionary) == 0:
+        return np.zeros(1, dtype=np.int32)
+    order = np.argsort(dictionary.astype(str), kind="stable")
+    ranks = np.empty(len(dictionary), dtype=np.int32)
+    ranks[order] = np.arange(len(dictionary), dtype=np.int32)
+    return ranks
+
+
+def rank_key(c: DCol) -> jax.Array:
+    """Device array usable as a grouping/ordering key for any logical dtype."""
+    c = _flatten_compound(c)
+    if c.dtype == "str":
+        lut = jnp.asarray(string_rank_lut(c.dictionary))
+        safe = jnp.clip(c.data, 0, lut.shape[0] - 1)
+        return jnp.where(c.valid, lut[safe], 0)
+    if c.dtype == "bool":
+        return jnp.where(c.valid, c.data.astype(jnp.int32), 0)
+    return jnp.where(c.valid, c.data, jnp.zeros((), dtype=c.data.dtype))

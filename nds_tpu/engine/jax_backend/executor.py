@@ -1,0 +1,518 @@
+"""Device plan executor: walks a bound plan over DTables (JAX arrays).
+
+Robust-mode contract: each node executes as XLA compute over padded buffers;
+row counts are host-synced only at shape-decision points (post filter/join/
+aggregate capacity planning). Any node the device backend does not yet cover
+falls back to the numpy oracle backend for that node only — results are
+bridged host<->device at the node boundary, so every query always runs.
+
+Mirrors engine/executor.py (which plays the role of Spark executors in the
+reference, nds/nds_power.py:124-134).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import ops as host_ops
+from ..column import Table
+from ..executor import Executor as HostExecutor
+from ..plan import (
+    AggregateNode, AggSpec, BExpr, DistinctNode, FilterNode, JoinNode,
+    LimitNode, MaterializedNode, PlanNode, ProjectNode, ScanNode, SetOpNode,
+    SortNode, WindowNode,
+)
+from . import jexprs, kernels
+from .device import (DCol, DTable, bucket, phys_dtype, rank_key,
+                     string_rank_lut, to_device, to_host)
+
+_I32 = jnp.int32
+
+
+class JaxExecutor:
+    """Executes bound plans on the JAX backend with per-node host fallback."""
+
+    def __init__(self, load_table: Callable[[str], Table],
+                 trace: Optional[Callable[[str, float, int], None]] = None):
+        self._load_table = load_table
+        self._memo: dict[int, DTable] = {}
+        self._scan_cache: dict[str, DTable] = {}
+        self._trace = trace
+        self.fallback_nodes: list[str] = []   # observability: who fell back
+
+    # -- public --------------------------------------------------------------
+    def execute(self, node: PlanNode) -> DTable:
+        key = id(node)
+        if key in self._memo:
+            return self._memo[key]
+        try:
+            result = self._run(node)
+        except NotImplementedError as e:
+            self.fallback_nodes.append(f"{type(node).__name__}: {e}")
+            result = self._host_fallback(node)
+        self._memo[key] = result
+        return result
+
+    def execute_to_host(self, node: PlanNode) -> Table:
+        return to_host(self.execute(node))
+
+    # -- helpers -------------------------------------------------------------
+    def _eval(self, expr: BExpr, table: DTable) -> DCol:
+        return jexprs.evaluate(expr, table, subquery_eval=self._scalar)
+
+    def _scalar(self, plan: PlanNode):
+        t = to_host(self.execute(plan))
+        if t.num_rows == 0:
+            return None
+        col = t.columns[0]
+        if not bool(col.validity[0]):
+            return None
+        if col.dtype == "str":
+            return col.decode()[0]
+        return np.asarray(col.data)[0].item()
+
+    def _host_fallback(self, node: PlanNode) -> DTable:
+        repl = {}
+        for f in ("child", "left", "right"):
+            sub = getattr(node, f, None)
+            if isinstance(sub, PlanNode):
+                t = to_host(self.execute(sub))
+                repl[f] = MaterializedNode(
+                    table=t, label=f"device:{f}",
+                    out_names=list(sub.out_names), out_dtypes=list(sub.out_dtypes))
+        host_node = dataclasses.replace(node, **repl) if repl else node
+        host = HostExecutor(self._load_table)
+        return to_device(host.execute(host_node))
+
+    def _maybe_compact(self, t: DTable) -> DTable:
+        count = int(t.count())
+        cap = bucket(count)
+        if t.capacity <= 2 * cap:
+            return t
+        perm, _ = kernels.compaction_perm(t.alive)
+        perm = perm[:cap]
+        cols = [DCol(c.dtype, c.data[perm], c.valid[perm], c.dictionary,
+                     None if c.parts is None else tuple(
+                         DCol(p.dtype, p.data[perm], p.valid[perm], p.dictionary)
+                         for p in c.parts))
+                for c in t.cols]
+        alive = jnp.arange(cap, dtype=_I32) < count
+        return DTable(t.names, cols, alive)
+
+    # -- node dispatch -------------------------------------------------------
+    def _run(self, node: PlanNode) -> DTable:
+        if isinstance(node, MaterializedNode):
+            return to_device(node.table)
+        if isinstance(node, ScanNode):
+            return self._run_scan(node)
+        if isinstance(node, FilterNode):
+            child = self.execute(node.child)
+            mask = self._eval(node.predicate, child)
+            alive = kernels.filter_alive(child.alive, mask.data, mask.valid)
+            return self._maybe_compact(DTable(list(node.out_names),
+                                              child.cols, alive))
+        if isinstance(node, ProjectNode):
+            child = self.execute(node.child)
+            cols = [self._eval(e, child) for e in node.exprs]
+            return DTable(list(node.out_names), cols, child.alive)
+        if isinstance(node, JoinNode):
+            return self._run_join(node)
+        if isinstance(node, AggregateNode):
+            return self._run_aggregate(node)
+        if isinstance(node, WindowNode):
+            raise NotImplementedError("window functions (device) pending")
+        if isinstance(node, SortNode):
+            return self._run_sort(node)
+        if isinstance(node, LimitNode):
+            child = self.execute(node.child)
+            alive = kernels.limit_alive(child.alive, node.n)
+            return self._maybe_compact(DTable(list(node.out_names),
+                                              child.cols, alive))
+        if isinstance(node, DistinctNode):
+            child = self.execute(node.child)
+            alive = self._distinct_alive(child, list(range(len(child.cols))))
+            return self._maybe_compact(DTable(list(node.out_names),
+                                              child.cols, alive))
+        if isinstance(node, SetOpNode):
+            return self._run_setop(node)
+        raise NotImplementedError(type(node).__name__)
+
+    def _run_setop(self, node: SetOpNode) -> DTable:
+        left = self.execute(node.left)
+        right = self.execute(node.right)
+        names = list(node.out_names)
+        both = _concat_dtables([left, right], names)
+        if node.op == "union":
+            if node.all:
+                return both
+            alive = self._distinct_alive(both, list(range(len(both.cols))))
+            return self._maybe_compact(DTable(names, both.cols, alive))
+        # intersect / except: distinct-row semantics (mirrors host ops.set_op)
+        lcap = left.capacity
+        n = both.capacity
+        iota = jnp.arange(n, dtype=_I32)
+        is_left = iota < lcap
+        keys = [rank_key(c) for c in both.cols]
+        valids = [c.valid for c in both.cols]
+        gid, _ = kernels.dense_rank(keys, valids, both.alive)
+        safe_gid = jnp.where(both.alive, gid, n)
+        in_left = jnp.zeros(n + 1, bool).at[
+            jnp.where(is_left, safe_gid, n)].set(True)
+        in_right = jnp.zeros(n + 1, bool).at[
+            jnp.where(~is_left, safe_gid, n)].set(True)
+        keep = (in_left & in_right) if node.op == "intersect" \
+            else (in_left & ~in_right)
+        first_left = jnp.full(n + 1, n, dtype=_I32).at[
+            jnp.where(both.alive & is_left, gid, n)].min(iota)
+        alive = both.alive & is_left & keep[jnp.clip(gid, 0, n)] & \
+            (first_left[jnp.clip(gid, 0, n)] == iota)
+        return self._maybe_compact(DTable(names, both.cols, alive))
+
+    def _run_scan(self, node: ScanNode) -> DTable:
+        cache_key = node.table + "//" + ",".join(node.columns)
+        if cache_key not in self._scan_cache:
+            t = self._load_table(node.table)
+            index = {n: i for i, n in enumerate(t.names)}
+            cols = [t.columns[index[c]] for c in node.columns]
+            self._scan_cache[cache_key] = to_device(
+                Table(list(node.out_names), cols))
+        cached = self._scan_cache[cache_key]
+        return DTable(list(node.out_names), cached.cols, cached.alive)
+
+    # -- sort / distinct -----------------------------------------------------
+    def _run_sort(self, node: SortNode) -> DTable:
+        child = self.execute(node.child)
+        key_cols = [self._eval(k.expr, child) for k in node.keys]
+        key_data = [rank_key(c) for c in key_cols]
+        key_valid = [c.valid for c in key_cols]
+        perm = kernels.sort_perm(key_data, key_valid, node.keys, child.alive)
+        cols = [_gather_col(c, perm) for c in child.cols]
+        return DTable(list(node.out_names), cols, child.alive[perm])
+
+    def _distinct_alive(self, t: DTable, col_idx: list[int]) -> jax.Array:
+        keys = [rank_key(t.cols[i]) for i in col_idx]
+        valids = [t.cols[i].valid for i in col_idx]
+        gid, _ = kernels.dense_rank(keys, valids, t.alive)
+        n = t.capacity
+        iota = jnp.arange(n, dtype=_I32)
+        first = jnp.full(n + 1, n, dtype=_I32).at[
+            jnp.where(t.alive, gid, n)].min(iota)
+        return t.alive & (first[jnp.clip(gid, 0, n)] == iota)
+
+    # -- aggregate -----------------------------------------------------------
+    def _run_aggregate(self, node: AggregateNode) -> DTable:
+        child = self.execute(node.child)
+        grouping_sets = [list(range(len(node.group_exprs)))]
+        if node.rollup:
+            grouping_sets = [list(range(k))
+                             for k in range(len(node.group_exprs), -1, -1)]
+        pieces = [self._aggregate_one(node, child, keep)
+                  for keep in grouping_sets]
+        if len(pieces) == 1:
+            return pieces[0]
+        return _concat_dtables(pieces, list(node.out_names))
+
+    def _aggregate_one(self, node: AggregateNode, child: DTable,
+                       keep: list[int]) -> DTable:
+        group_cols = [self._eval(e, child) for e in node.group_exprs]
+        cap = child.capacity
+        active = [group_cols[i] for i in keep]
+        gid, num_groups_t = kernels.dense_rank(
+            [rank_key(c) for c in active], [c.valid for c in active],
+            child.alive)
+        num_groups = max(int(num_groups_t), 1 if not node.group_exprs else 0)
+        if not node.group_exprs and num_groups == 0:
+            # global aggregate over empty input still yields one row
+            gid = jnp.zeros(cap, _I32)
+            num_groups = 1
+            alive_for_agg = child.alive
+        else:
+            alive_for_agg = child.alive
+        cap_out = bucket(max(num_groups, 1))
+
+        out_cols: list[DCol] = []
+        keep_set = set(keep)
+        for i, gc in enumerate(group_cols):
+            if i in keep_set:
+                vals, valid = kernels.group_representatives(
+                    gid, alive_for_agg, gc.canon().data, gc.valid, cap_out)
+                out_cols.append(DCol(gc.dtype, vals, valid, gc.dictionary))
+            else:  # rolled-up column: NULL
+                out_cols.append(DCol(gc.dtype,
+                                     jnp.zeros(cap_out, phys_dtype(gc.dtype)),
+                                     jnp.zeros(cap_out, bool), gc.dictionary))
+
+        agg_results = self._compute_aggs(node.aggs, child, gid,
+                                         alive_for_agg, cap_out)
+        out_cols.extend(agg_results)
+        if node.rollup:
+            gid_val = sum(1 << (len(node.group_exprs) - 1 - i)
+                          for i in range(len(node.group_exprs))
+                          if i not in keep_set)
+            out_cols.append(DCol("int",
+                                 jnp.full(cap_out, gid_val, phys_dtype("int")),
+                                 jnp.ones(cap_out, bool)))
+        alive = jnp.arange(cap_out, dtype=_I32) < num_groups
+        names = list(node.out_names)
+        return DTable(names, out_cols, alive)
+
+    def _compute_aggs(self, specs: list[AggSpec], child: DTable,
+                      gid: jax.Array, alive: jax.Array,
+                      cap_out: int) -> list[DCol]:
+        out: list[DCol] = []
+        for spec in specs:
+            arg_col = None if spec.arg is None else self._eval(spec.arg, child)
+            use_alive = alive
+            if spec.distinct and arg_col is not None:
+                use_alive = kernels.distinct_within_group(
+                    gid, alive, rank_key(arg_col), arg_col.valid)
+            if arg_col is not None and arg_col.dtype == "str":
+                out.append(self._agg_string(spec, arg_col, gid, use_alive,
+                                            cap_out))
+                continue
+            arg = None
+            if arg_col is not None:
+                data = arg_col.canon().data
+                if spec.func == "sum" and arg_col.dtype == "int":
+                    data = data.astype(phys_dtype("int"))
+                arg = (data, arg_col.valid)
+            (vals, valid), = kernels.aggregate(gid, use_alive, [spec], [arg],
+                                               cap_out)
+            out.append(DCol(spec.dtype, vals.astype(phys_dtype(spec.dtype)),
+                            valid))
+        return out
+
+    def _agg_string(self, spec: AggSpec, arg_col: DCol, gid: jax.Array,
+                    alive: jax.Array, cap_out: int) -> DCol:
+        if spec.func == "count":
+            (vals, valid), = kernels.aggregate(
+                gid, alive, [spec], [(jnp.zeros_like(arg_col.data),
+                                      arg_col.valid)], cap_out)
+            return DCol("int", vals.astype(phys_dtype("int")), valid)
+        if spec.func not in ("min", "max"):
+            raise NotImplementedError(f"device {spec.func} over strings")
+        d = arg_col.dictionary if arg_col.dictionary is not None \
+            else np.empty(0, dtype=object)
+        ranks = string_rank_lut(d)
+        order = np.argsort(d.astype(str), kind="stable") if len(d) \
+            else np.zeros(1, dtype=np.int64)
+        rank_data = jexprs._lut_gather(arg_col.data, ranks)
+        mm_spec = AggSpec(func=spec.func, arg=spec.arg, distinct=False,
+                          name=spec.name)
+        (vals, valid), = kernels.aggregate(gid, alive, [mm_spec],
+                                           [(rank_data, arg_col.valid)],
+                                           cap_out)
+        codes = jexprs._lut_gather(vals.astype(_I32),
+                                   order.astype(np.int32))
+        return DCol("str", codes, valid, arg_col.dictionary)
+
+    # -- joins ---------------------------------------------------------------
+    def _run_join(self, node: JoinNode) -> DTable:
+        if node.kind == "right":
+            return self._right_join(node)
+        left = self.execute(node.left)
+        right = self.execute(node.right)
+        return self._join(node, left, right)
+
+    def _right_join(self, node: JoinNode) -> DTable:
+        # right join == left join with sides swapped, columns re-ordered
+        swapped = dataclasses.replace(
+            node, kind="left", left=node.right, right=node.left,
+            left_keys=node.right_keys, right_keys=node.left_keys,
+            residual=None,
+            out_names=[f"__r{i}" for i in range(len(node.out_names))])
+        if node.residual is not None:
+            raise NotImplementedError("right join with residual (device)")
+        lt = self.execute(node.left)
+        rt = self.execute(node.right)
+        out = self._join(swapped, rt, lt)
+        nl = len(lt.cols)
+        cols = out.cols[len(rt.cols):] + out.cols[:len(rt.cols)]
+        assert len(cols) == nl + len(rt.cols)
+        return DTable(list(node.out_names), cols, out.alive)
+
+    def _join(self, node: JoinNode, left: DTable, right: DTable) -> DTable:
+        kind = node.kind
+        lcap, rcap = left.capacity, right.capacity
+        if kind == "cross":
+            lo = jnp.zeros(lcap, _I32)
+            perm, rcount_t = kernels.compaction_perm(right.alive)
+            rcount = int(rcount_t)
+            cnt = jnp.where(left.alive, rcount, 0).astype(_I32)
+            return self._expand_combine(node, left, right, lo, cnt, perm,
+                                        residual=node.residual)
+
+        lkeys = [self._eval(e, left) for e in node.left_keys]
+        rkeys = [self._eval(e, right) for e in node.right_keys]
+        lvalid = jnp.ones(lcap, bool)
+        rvalid = jnp.ones(rcap, bool)
+        for c in lkeys:
+            lvalid = lvalid & c.valid
+        for c in rkeys:
+            rvalid = rvalid & c.valid
+
+        key_data = []
+        for lc, rc in zip(lkeys, rkeys):
+            ld, rd = _joinable_pair(lc, rc)
+            key_data.append(jnp.concatenate([ld, rd]))
+        match_alive = jnp.concatenate([left.alive & lvalid,
+                                       right.alive & rvalid])
+        gid, _ = kernels.dense_rank(
+            key_data, [jnp.ones(lcap + rcap, bool)] * len(key_data),
+            match_alive)
+        l_gid, r_gid = gid[:lcap], gid[lcap:]
+
+        sorted_gid, perm_r = kernels.build_side(
+            jnp.where(match_alive[lcap:], r_gid, jnp.iinfo(_I32).max),
+            right.alive & rvalid)
+        lo, cnt = kernels.probe_counts(sorted_gid,
+                                       jnp.where(match_alive[:lcap], l_gid,
+                                                 jnp.iinfo(_I32).max - 1),
+                                       left.alive & lvalid)
+
+        if kind in ("semi", "anti") and node.residual is None:
+            matched = cnt > 0
+            if kind == "semi":
+                alive = left.alive & matched
+            else:
+                if node.null_aware:
+                    build_has_null = bool(jnp.any(right.alive & ~rvalid))
+                    if build_has_null:
+                        alive = jnp.zeros(lcap, bool)
+                    else:
+                        alive = left.alive & lvalid & ~matched
+                else:
+                    alive = left.alive & ~matched
+            return self._maybe_compact(
+                DTable(list(node.out_names), left.cols, alive))
+
+        if kind in ("semi", "anti"):
+            # residual semi/anti: expand, evaluate, reduce to a left-row flag
+            expanded = self._expand_combine(node, left, right, lo, cnt, perm_r,
+                                            residual=node.residual,
+                                            keep_left_idx=True)
+            combined, left_idx = expanded
+            hit = jax.ops.segment_sum(
+                combined.alive.astype(_I32),
+                jnp.where(combined.alive, left_idx, lcap),
+                num_segments=lcap + 1)[:lcap] > 0
+            alive = left.alive & hit if kind == "semi" else left.alive & ~hit
+            return self._maybe_compact(
+                DTable(list(node.out_names), left.cols, alive))
+
+        if kind == "full":
+            raise NotImplementedError("full outer join (device) pending")
+        inner = self._expand_combine(node, left, right, lo, cnt, perm_r,
+                                     residual=node.residual,
+                                     keep_left_idx=(kind == "left"))
+        if kind == "inner":
+            return inner
+        combined, left_idx = inner
+        matched_left = jax.ops.segment_sum(
+            combined.alive.astype(_I32),
+            jnp.where(combined.alive, left_idx, lcap),
+            num_segments=lcap + 1)[:lcap] > 0
+        unmatched = left.alive & ~matched_left
+        pieces = [combined, _null_extend(left, right, unmatched, side="right",
+                                         names=list(node.out_names))]
+        return _concat_dtables(pieces, list(node.out_names))
+
+    def _expand_combine(self, node: JoinNode, left: DTable, right: DTable,
+                        lo, cnt, perm_r, residual=None, keep_left_idx=False):
+        total = int(jnp.sum(cnt))
+        cap_out = bucket(max(total, 1))
+        left_idx, build_pos, alive_out = kernels.expand_join(
+            lo, cnt, left.alive, cap_out)
+        right_rows = perm_r[jnp.clip(build_pos, 0, right.capacity - 1)]
+        cols = [_gather_col(c, left_idx) for c in left.cols] + \
+               [_gather_col(c, right_rows) for c in right.cols]
+        names = list(node.out_names) if len(node.out_names) == len(cols) \
+            else [f"__c{i}" for i in range(len(cols))]
+        out = DTable(names, cols, alive_out)
+        if residual is not None:
+            mask = jexprs.evaluate(residual, out, subquery_eval=self._scalar)
+            out = DTable(out.names, out.cols,
+                         kernels.filter_alive(out.alive, mask.data, mask.valid))
+        if keep_left_idx:
+            return out, left_idx
+        return self._maybe_compact(out)
+
+
+# -- column utilities --------------------------------------------------------
+
+def _gather_col(c: DCol, idx: jax.Array) -> DCol:
+    parts = None
+    if c.parts is not None:
+        parts = tuple(DCol(p.dtype, p.data[idx], p.valid[idx], p.dictionary)
+                      for p in c.parts)
+    return DCol(c.dtype, c.data[idx], c.valid[idx], c.dictionary, parts)
+
+
+def _joinable_pair(a: DCol, b: DCol) -> tuple[jax.Array, jax.Array]:
+    """Comparable device key arrays for a join key pair."""
+    if a.dtype == "str" or b.dtype == "str":
+        return jexprs._string_pair_keys(a, b)
+    da, db = a.canon().data, b.canon().data
+    if da.dtype != db.dtype:
+        ct = jnp.promote_types(da.dtype, db.dtype)
+        da, db = da.astype(ct), db.astype(ct)
+    return da, db
+
+
+def _null_extend(left: DTable, right: DTable, left_mask: jax.Array,
+                 side: str, names: list[str]) -> DTable:
+    """Left rows selected by mask, with the right side all-NULL (outer join)."""
+    cols = [DCol(c.dtype, c.data, c.valid, c.dictionary, c.parts)
+            for c in left.cols]
+    for c in right.cols:
+        cols.append(DCol(c.dtype,
+                         jnp.zeros(left.capacity, c.data.dtype),
+                         jnp.zeros(left.capacity, bool), c.dictionary))
+    return DTable(names, cols, left_mask)
+
+
+def _concat_dtables(pieces: list[DTable], names: list[str]) -> DTable:
+    """Row-concatenate device tables (merging string dictionaries on host)."""
+    ncols = len(pieces[0].cols)
+    out_cols: list[DCol] = []
+    for ci in range(ncols):
+        cols = [_flatten_for_concat(p.cols[ci]) for p in pieces]
+        dtype = cols[0].dtype
+        if dtype == "str":
+            merged: dict[str, int] = {}
+            datas = []
+            for c in cols:
+                d = c.dictionary if c.dictionary is not None \
+                    else np.empty(0, dtype=object)
+                lut = np.empty(len(d), dtype=np.int32)
+                for i, v in enumerate(d):
+                    if v not in merged:
+                        merged[v] = len(merged)
+                    lut[i] = merged[v]
+                datas.append(jexprs._lut_gather(c.data, lut) if len(d)
+                             else jnp.zeros(len(c), _I32))
+            dictionary = np.empty(len(merged), dtype=object)
+            for v, i in merged.items():
+                dictionary[i] = v
+            data = jnp.concatenate(datas)
+            out_cols.append(DCol("str", data,
+                                 jnp.concatenate([c.valid for c in cols]),
+                                 dictionary))
+        else:
+            pd = cols[0].data.dtype
+            data = jnp.concatenate([c.data.astype(pd) for c in cols])
+            out_cols.append(DCol(dtype, data,
+                                 jnp.concatenate([c.valid for c in cols])))
+    alive = jnp.concatenate([p.alive for p in pieces])
+    return DTable(names, out_cols, alive)
+
+
+def _flatten_for_concat(c: DCol) -> DCol:
+    if c.parts is None:
+        return c
+    from .device import _flatten_compound
+    return _flatten_compound(c)

@@ -1,0 +1,417 @@
+"""Bound-expression evaluation on device columns.
+
+Same Spark-SQL null semantics as engine/exprs.py (the numpy oracle), but as
+traceable JAX compute. String work never touches the device: predicates,
+substrings and parses are computed once over the host-side dictionary and
+become gather LUTs; only int32 codes flow through XLA. Ops with genuinely
+row-wise string output (concat) produce lazy compound columns.
+
+Raises NotImplementedError for the few host-only cases; the executor falls
+back to the numpy backend for that plan node.
+"""
+from __future__ import annotations
+
+import re
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..plan import BCall, BCol, BExpr, BLit, BScalarSubquery
+from .device import DCol, DTable, phys_dtype, string_rank_lut
+
+SubqueryEval = Callable[[object], object]
+
+
+def _float_dtype():
+    return jnp.float64 if jax.config.read("jax_enable_x64") else jnp.float32
+
+
+def evaluate(expr: BExpr, table: DTable,
+             subquery_eval: Optional[SubqueryEval] = None) -> DCol:
+    n = table.alive.shape[0]
+    if isinstance(expr, BCol):
+        return table.cols[expr.index]
+    if isinstance(expr, BLit):
+        return constant(expr.dtype, expr.value, n)
+    if isinstance(expr, BScalarSubquery):
+        if subquery_eval is None:
+            raise RuntimeError("scalar subquery encountered without evaluator")
+        return constant(expr.dtype, subquery_eval(expr.plan), n)
+    if isinstance(expr, BCall):
+        handler = _HANDLERS.get(expr.op)
+        if handler is None:
+            raise NotImplementedError(f"device expression op {expr.op!r}")
+        return handler(expr, table, subquery_eval)
+    raise TypeError(type(expr).__name__)
+
+
+def constant(dtype: str, value, n: int) -> DCol:
+    pd = phys_dtype(dtype)
+    if value is None:
+        return DCol(dtype, jnp.zeros(n, pd), jnp.zeros(n, bool))
+    if dtype == "str":
+        return DCol("str", jnp.zeros(n, jnp.int32), jnp.ones(n, bool),
+                    np.asarray([value], dtype=object))
+    if dtype == "bool":
+        value = bool(value)
+    return DCol(dtype, jnp.full(n, value, dtype=pd), jnp.ones(n, bool))
+
+
+def _args(expr: BCall, table: DTable, sq) -> list[DCol]:
+    return [evaluate(a, table, sq) for a in expr.args]
+
+
+def _both(a: DCol, b: DCol) -> jax.Array:
+    return a.valid & b.valid
+
+
+# -- string dictionary helpers (host-side, trace-time constants) -------------
+
+def _dict(c: DCol) -> np.ndarray:
+    if c.parts is not None:
+        raise NotImplementedError("compound string used in unsupported op")
+    return c.dictionary if c.dictionary is not None else np.empty(0, dtype=object)
+
+
+def _lut_gather(codes: jax.Array, lut: np.ndarray) -> jax.Array:
+    dlut = jnp.asarray(lut)
+    if dlut.shape[0] == 0:
+        return jnp.zeros(codes.shape, dlut.dtype)
+    return dlut[jnp.clip(codes, 0, dlut.shape[0] - 1)]
+
+
+def _merge_dicts(da: np.ndarray, db: np.ndarray
+                 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Common dictionary + per-side code remap LUTs (host)."""
+    seen: dict[str, int] = {}
+    luts = []
+    for d in (da, db):
+        lut = np.empty(len(d), dtype=np.int32)
+        for i, v in enumerate(d):
+            if v not in seen:
+                seen[v] = len(seen)
+            lut[i] = seen[v]
+        luts.append(lut)
+    merged = np.empty(len(seen), dtype=object)
+    for v, i in seen.items():
+        merged[i] = v
+    return merged, luts[0], luts[1]
+
+
+def _string_pair_keys(a: DCol, b: DCol) -> tuple[jax.Array, jax.Array]:
+    """Comparable int keys for two string columns (merged lexicographic rank)."""
+    merged, la, lb = _merge_dicts(_dict(a), _dict(b))
+    ranks = string_rank_lut(merged)
+    ka = _lut_gather(_lut_gather(a.data, la), ranks)
+    kb = _lut_gather(_lut_gather(b.data, lb), ranks)
+    return ka, kb
+
+
+# -- arithmetic --------------------------------------------------------------
+
+def _arith(op: str):
+    def run(expr: BCall, table: DTable, sq) -> DCol:
+        a, b = _args(expr, table, sq)
+        valid = _both(a, b)
+        if op == "div":
+            fd = _float_dtype()
+            da, db = a.data.astype(fd), b.data.astype(fd)
+            zero = db == 0
+            out = da / jnp.where(zero, 1.0, db)
+            return DCol("float", jnp.where(valid & ~zero, out, 0.0),
+                        valid & ~zero)
+        fns = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+               "mod": jnp.fmod}
+        if a.dtype == "float" or b.dtype == "float" or expr.dtype == "float":
+            fd = _float_dtype()
+            out = fns[op](a.data.astype(fd), b.data.astype(fd))
+            return DCol("float", jnp.where(valid, out, 0.0), valid)
+        pd = phys_dtype("int")
+        out = fns[op](a.data.astype(pd), b.data.astype(pd))
+        dtype = expr.dtype if expr.dtype in ("int", "date") else "int"
+        out = out.astype(phys_dtype(dtype))
+        return DCol(dtype, jnp.where(valid, out, 0), valid)
+    return run
+
+
+def _neg(expr: BCall, table: DTable, sq) -> DCol:
+    a = evaluate(expr.args[0], table, sq)
+    return DCol(a.dtype, -a.data, a.valid)
+
+
+# -- comparisons -------------------------------------------------------------
+
+_CMP = {"eq": jnp.equal, "ne": jnp.not_equal, "lt": jnp.less,
+        "le": jnp.less_equal, "gt": jnp.greater, "ge": jnp.greater_equal}
+
+
+def _compare(op: str):
+    def run(expr: BCall, table: DTable, sq) -> DCol:
+        a, b = _args(expr, table, sq)
+        valid = _both(a, b)
+        if a.dtype == "str" or b.dtype == "str":
+            ka, kb = _string_pair_keys(a, b)
+            out = _CMP[op](ka, kb)
+        else:
+            da, db = a.data, b.data
+            if da.dtype != db.dtype:
+                ct = jnp.promote_types(da.dtype, db.dtype)
+                da, db = da.astype(ct), db.astype(ct)
+            out = _CMP[op](da, db)
+        return DCol("bool", out & valid, valid)
+    return run
+
+
+# -- boolean -----------------------------------------------------------------
+
+def _and(expr: BCall, table: DTable, sq) -> DCol:
+    a, b = _args(expr, table, sq)
+    ta, tb = a.data.astype(bool) & a.valid, b.data.astype(bool) & b.valid
+    fa, fb = ~a.data.astype(bool) & a.valid, ~b.data.astype(bool) & b.valid
+    out = ta & tb
+    return DCol("bool", out, out | fa | fb)
+
+
+def _or(expr: BCall, table: DTable, sq) -> DCol:
+    a, b = _args(expr, table, sq)
+    ta, tb = a.data.astype(bool) & a.valid, b.data.astype(bool) & b.valid
+    fa, fb = ~a.data.astype(bool) & a.valid, ~b.data.astype(bool) & b.valid
+    out = ta | tb
+    return DCol("bool", out, out | (fa & fb))
+
+
+def _not(expr: BCall, table: DTable, sq) -> DCol:
+    a = evaluate(expr.args[0], table, sq)
+    return DCol("bool", ~a.data.astype(bool) & a.valid, a.valid)
+
+
+def _isnull(expr: BCall, table: DTable, sq) -> DCol:
+    a = evaluate(expr.args[0], table, sq)
+    n = table.alive.shape[0]
+    return DCol("bool", ~a.valid, jnp.ones(n, bool))
+
+
+def _isnotnull(expr: BCall, table: DTable, sq) -> DCol:
+    a = evaluate(expr.args[0], table, sq)
+    n = table.alive.shape[0]
+    return DCol("bool", a.valid, jnp.ones(n, bool))
+
+
+# -- predicates --------------------------------------------------------------
+
+def _in_list(expr: BCall, table: DTable, sq) -> DCol:
+    a = evaluate(expr.args[0], table, sq)
+    values = expr.extra
+    has_null = any(v is None for v in values)
+    if a.dtype == "str":
+        d = _dict(a)
+        vset = {v for v in values if v is not None}
+        hit = np.asarray([v in vset for v in d], dtype=bool)
+        out = _lut_gather(a.data, hit) if len(d) else jnp.zeros(len(a), bool)
+    else:
+        vals = [v for v in values if v is not None]
+        if not vals:
+            out = jnp.zeros(a.data.shape, bool)
+        else:
+            arr = jnp.asarray(vals).astype(a.data.dtype)
+            out = jnp.isin(a.data, arr)
+    valid = a.valid
+    if has_null:
+        valid = valid & out
+    return DCol("bool", out & valid, valid)
+
+
+def _like_to_regex(pattern: str) -> re.Pattern:
+    out = []
+    for ch in pattern:
+        out.append(".*" if ch == "%" else "." if ch == "_" else re.escape(ch))
+    return re.compile("^" + "".join(out) + "$", re.DOTALL)
+
+
+def _like(expr: BCall, table: DTable, sq) -> DCol:
+    a = evaluate(expr.args[0], table, sq)
+    if a.dtype != "str":
+        raise NotImplementedError("LIKE on non-string column")
+    pattern = _like_to_regex(str(expr.extra))
+    d = _dict(a)
+    hit = np.asarray([bool(pattern.match(v)) for v in d], dtype=bool)
+    out = _lut_gather(a.data, hit) if len(d) else jnp.zeros(len(a), bool)
+    return DCol("bool", out & a.valid, a.valid)
+
+
+# -- conditional -------------------------------------------------------------
+
+def _case(expr: BCall, table: DTable, sq) -> DCol:
+    pairs = expr.args[:-1]
+    else_col = evaluate(expr.args[-1], table, sq)
+    result_dtype = expr.dtype
+    branch_cols = [evaluate(pairs[i + 1], table, sq)
+                   for i in range(0, len(pairs), 2)]
+    branch_cols.append(else_col)
+    dictionary = None
+    if result_dtype == "str":
+        dictionary, datas = _merge_branch_strings(branch_cols)
+    else:
+        pd = phys_dtype(result_dtype)
+        datas = [c.data.astype(pd) for c in branch_cols]
+    out = datas[-1]
+    valid = branch_cols[-1].valid
+    # fold branches in reverse so earlier WHENs win
+    for i in range(len(pairs) - 2, -1, -2):
+        cond = evaluate(pairs[i], table, sq)
+        fire = cond.data.astype(bool) & cond.valid
+        bi = i // 2
+        out = jnp.where(fire, datas[bi], out)
+        valid = jnp.where(fire, branch_cols[bi].valid, valid)
+    return DCol(result_dtype, out, valid, dictionary)
+
+
+def _merge_branch_strings(cols: list[DCol]) -> tuple[np.ndarray, list]:
+    merged: dict[str, int] = {}
+    datas = []
+    for c in cols:
+        d = _dict(c)
+        lut = np.empty(len(d), dtype=np.int32)
+        for i, v in enumerate(d):
+            if v not in merged:
+                merged[v] = len(merged)
+            lut[i] = merged[v]
+        datas.append(_lut_gather(c.data, lut) if len(d)
+                     else jnp.zeros(len(c), jnp.int32))
+    out = np.empty(len(merged), dtype=object)
+    for v, i in merged.items():
+        out[i] = v
+    return out, datas
+
+
+def _coalesce(expr: BCall, table: DTable, sq) -> DCol:
+    cols = _args(expr, table, sq)
+    result_dtype = expr.dtype
+    dictionary = None
+    if result_dtype == "str":
+        dictionary, datas = _merge_branch_strings(cols)
+    else:
+        pd = phys_dtype(result_dtype)
+        datas = [c.data.astype(pd) for c in cols]
+    out = datas[-1]
+    valid = cols[-1].valid
+    for i in range(len(cols) - 2, -1, -1):
+        out = jnp.where(cols[i].valid, datas[i], out)
+        valid = cols[i].valid | valid
+    return DCol(result_dtype, out, valid, dictionary)
+
+
+def _nullif(expr: BCall, table: DTable, sq) -> DCol:
+    a, b = _args(expr, table, sq)
+    if a.dtype == "str" or b.dtype == "str":
+        ka, kb = _string_pair_keys(a, b)
+        same = ka == kb
+    else:
+        same = a.data == b.data.astype(a.data.dtype)
+    same = same & a.valid & b.valid
+    return DCol(a.dtype, a.data, a.valid & ~same, a.dictionary, a.parts)
+
+
+# -- casts & scalar functions ------------------------------------------------
+
+def _cast(expr: BCall, table: DTable, sq) -> DCol:
+    a = evaluate(expr.args[0], table, sq)
+    target = expr.dtype
+    if target == a.dtype:
+        return a
+    if a.dtype == "str":
+        return _cast_from_str(a, target)
+    if target == "str":
+        raise NotImplementedError("cast to string on device")
+    if target in ("int", "float", "date"):
+        return DCol(target, a.data.astype(phys_dtype(target)), a.valid)
+    raise NotImplementedError(f"cast to {target}")
+
+
+def _cast_from_str(a: DCol, target: str) -> DCol:
+    """Parse the dictionary on the host; codes gather the parsed values."""
+    d = _dict(a)
+    vals = np.zeros(max(len(d), 1),
+                    dtype={"int": np.int64, "float": np.float64,
+                           "date": np.int32}[target])
+    ok = np.zeros(max(len(d), 1), dtype=bool)
+    for i, v in enumerate(d):
+        try:
+            if target == "date":
+                vals[i] = np.datetime64(v, "D").astype(np.int32)
+            elif target == "int":
+                vals[i] = int(float(v))
+            else:
+                vals[i] = float(v)
+            ok[i] = True
+        except (ValueError, TypeError):
+            pass
+    out = _lut_gather(a.data, vals).astype(phys_dtype(target))
+    valid = a.valid & _lut_gather(a.data, ok)
+    return DCol(target, jnp.where(valid, out, 0), valid)
+
+
+def _substr(expr: BCall, table: DTable, sq) -> DCol:
+    a = evaluate(expr.args[0], table, sq)
+    start, length = expr.extra
+    d = _dict(a)
+    lo = start - 1 if start > 0 else 0
+    hi = None if length is None else lo + length
+    newd = np.asarray([v[lo:hi] for v in d.astype(str)], dtype=object)
+    if len(newd) == 0:
+        return DCol("str", a.data, a.valid, np.empty(0, dtype=object))
+    uniq, remap = np.unique(newd.astype(str), return_inverse=True)
+    codes = _lut_gather(a.data, remap.astype(np.int32))
+    return DCol("str", codes, a.valid, uniq.astype(object))
+
+
+def _concat(expr: BCall, table: DTable, sq) -> DCol:
+    cols = _args(expr, table, sq)
+    parts: list[DCol] = []
+    valid = None
+    for c in cols:
+        if c.dtype != "str":
+            raise NotImplementedError("device concat of non-string")
+        valid = c.valid if valid is None else (valid & c.valid)
+        parts.extend(c.parts if c.parts is not None else (c,))
+    return DCol("str", jnp.zeros(len(cols[0]), jnp.int32), valid,
+                None, tuple(parts))
+
+
+def _abs(expr: BCall, table: DTable, sq) -> DCol:
+    a = evaluate(expr.args[0], table, sq)
+    return DCol(a.dtype, jnp.abs(a.data), a.valid)
+
+
+def _round(expr: BCall, table: DTable, sq) -> DCol:
+    a = evaluate(expr.args[0], table, sq)
+    digits = expr.extra if expr.extra is not None else 0
+    data = a.data.astype(_float_dtype())
+    scale = 10.0 ** digits
+    out = jnp.floor(jnp.abs(data) * scale + 0.5) / scale * jnp.sign(data)
+    if expr.dtype == "int":
+        return DCol("int", out.astype(phys_dtype("int")), a.valid)
+    return DCol("float", out, a.valid)
+
+
+def _grouping_bit(expr: BCall, table: DTable, sq) -> DCol:
+    a = evaluate(expr.args[0], table, sq)
+    bit = int(expr.extra)
+    out = (a.data.astype(phys_dtype("int")) >> bit) & 1
+    return DCol("int", out, a.valid)
+
+
+_HANDLERS = {
+    "add": _arith("add"), "sub": _arith("sub"), "mul": _arith("mul"),
+    "div": _arith("div"), "mod": _arith("mod"), "neg": _neg,
+    "eq": _compare("eq"), "ne": _compare("ne"), "lt": _compare("lt"),
+    "le": _compare("le"), "gt": _compare("gt"), "ge": _compare("ge"),
+    "and": _and, "or": _or, "not": _not,
+    "isnull": _isnull, "isnotnull": _isnotnull,
+    "in_list": _in_list, "like": _like,
+    "case": _case, "coalesce": _coalesce, "cast": _cast,
+    "substr": _substr, "concat": _concat, "abs": _abs, "round": _round,
+    "nullif": _nullif, "grouping_bit": _grouping_bit,
+}

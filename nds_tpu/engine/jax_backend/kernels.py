@@ -1,0 +1,265 @@
+"""Relational kernels as traceable JAX programs (static shapes, masked rows).
+
+Design rules (TPU/XLA-first):
+- No data-dependent shapes inside a kernel: outputs are padded to a capacity
+  chosen by the caller; a row-`alive` mask carries the logical row set.
+- No hashing: grouping and joins are sort-based (`lax.sort` is deterministic
+  and maps well onto TPU); multi-column keys are reduced to a dense group id
+  by a joint factorize, so every join/aggregate is single-int-key.
+- Nulls ride as validity masks; null payload slots are canonical zeros.
+
+These kernels are the device counterparts of engine/ops.py (the numpy oracle
+backend, which mirrors what the reference gets from Spark SQL executors,
+reference nds/nds_power.py:124-134).
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..plan import AggSpec, SortKey, WindowFunc
+
+_I32 = jnp.int32
+
+
+def _iota(n: int) -> jax.Array:
+    return jnp.arange(n, dtype=_I32)
+
+
+# ---------------------------------------------------------------------------
+# factorize: joint dense ranking of key tuples
+# ---------------------------------------------------------------------------
+
+def dense_rank(key_data: list[jax.Array], key_valid: list[jax.Array],
+               alive: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Assign each alive row a dense group id over its key tuple.
+
+    Returns (gid, num_groups): gid[i] in [0, num_groups) for alive rows and
+    == capacity (sentinel segment) for dead rows. Deterministic (sort-based).
+    """
+    n = alive.shape[0]
+    operands: list[jax.Array] = [(~alive).astype(_I32)]
+    for d, v in zip(key_data, key_valid):
+        operands.append((~v).astype(_I32))
+        operands.append(jnp.where(v & alive, d, jnp.zeros((), d.dtype)))
+    num_keys = len(operands)
+    out = lax.sort(tuple(operands) + (_iota(n),), num_keys=num_keys,
+                   is_stable=True)
+    perm = out[-1]
+    alive_sorted = out[0] == 0
+    diff = jnp.zeros(n, dtype=bool)
+    for k in out[1:num_keys]:
+        diff = diff | jnp.concatenate([jnp.ones(1, bool), k[1:] != k[:-1]])
+    if num_keys == 1:  # no keys: single global group
+        diff = jnp.concatenate([jnp.ones(1, bool), jnp.zeros(n - 1, bool)])
+    new_group = diff & alive_sorted
+    # first alive row must open a group even if `diff` logic missed it
+    new_group = new_group | (alive_sorted &
+                             jnp.concatenate([jnp.ones(1, bool), ~alive_sorted[:-1]]))
+    gid_sorted = jnp.cumsum(new_group.astype(_I32)) - 1
+    num_groups = jnp.max(jnp.where(alive_sorted, gid_sorted, -1)) + 1
+    gid = jnp.zeros(n, _I32).at[perm].set(
+        jnp.where(alive_sorted, gid_sorted, n))
+    return gid, num_groups
+
+
+# ---------------------------------------------------------------------------
+# filter / compact / limit
+# ---------------------------------------------------------------------------
+
+def filter_alive(alive: jax.Array, mask_data: jax.Array,
+                 mask_valid: jax.Array) -> jax.Array:
+    return alive & mask_data.astype(bool) & mask_valid
+
+
+def compaction_perm(alive: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Stable permutation bringing alive rows to the front; returns (perm, count)."""
+    n = alive.shape[0]
+    dead = (~alive).astype(_I32)
+    _, perm = lax.sort((dead, _iota(n)), num_keys=1, is_stable=True)
+    return perm, jnp.sum(alive.astype(_I32))
+
+
+def limit_alive(alive: jax.Array, n_keep: int) -> jax.Array:
+    """Keep the first `n_keep` alive rows in physical order."""
+    pos = jnp.cumsum(alive.astype(_I32)) - 1
+    return alive & (pos < n_keep)
+
+
+# ---------------------------------------------------------------------------
+# sort
+# ---------------------------------------------------------------------------
+
+def sort_perm(key_data: list[jax.Array], key_valid: list[jax.Array],
+              keys: list[SortKey], alive: jax.Array) -> jax.Array:
+    """Permutation realizing Spark ORDER BY semantics; dead rows go last."""
+    n = alive.shape[0]
+    operands: list[jax.Array] = [(~alive).astype(_I32)]
+    for col, valid, k in zip(key_data, key_valid, keys):
+        nulls_first = k.nulls_first if k.nulls_first is not None else k.asc
+        # null rank: 0 => before values, 2 => after values; values rank 1
+        null_rank = jnp.where(valid, 1, 0 if nulls_first else 2).astype(_I32)
+        operands.append(null_rank)
+        d = jnp.where(valid & alive, col, jnp.zeros((), col.dtype))
+        if not k.asc:
+            d = (~d) if d.dtype == jnp.bool_ else -d
+        operands.append(d)
+    out = lax.sort(tuple(operands) + (_iota(n),), num_keys=len(operands),
+                   is_stable=True)
+    return out[-1]
+
+
+# ---------------------------------------------------------------------------
+# aggregation
+# ---------------------------------------------------------------------------
+
+def _seg(data: jax.Array, gid: jax.Array, num_segments: int, op: str) -> jax.Array:
+    if op == "sum":
+        return jax.ops.segment_sum(data, gid, num_segments=num_segments)
+    if op == "min":
+        return jax.ops.segment_min(data, gid, num_segments=num_segments)
+    if op == "max":
+        return jax.ops.segment_max(data, gid, num_segments=num_segments)
+    raise AssertionError(op)
+
+
+def aggregate(gid: jax.Array, alive: jax.Array, specs: list[AggSpec],
+              args: list, cap_out: int) -> list[tuple[jax.Array, jax.Array]]:
+    """Per-group aggregates. `args` are (data, valid) tuples or None.
+
+    Returns one (values, valid) per spec, each length cap_out. gid for dead
+    rows must be >= cap_out (the sentinel from dense_rank works when
+    cap_out == capacity + 1 is NOT required — callers pass num_segments-safe
+    capacity; dead rows land in segment `capacity` and callers slice).
+    """
+    results = []
+    counts_cache: dict[int, jax.Array] = {}
+
+    def contrib_count(valid):
+        key = id(valid)
+        if key not in counts_cache:
+            counts_cache[key] = jax.ops.segment_sum(
+                (alive & valid).astype(jnp.int64 if jax.config.read("jax_enable_x64")
+                 else _I32), gid, num_segments=cap_out)
+        return counts_cache[key]
+
+    for spec, arg in zip(specs, args):
+        if spec.func == "count_star":
+            ones = jnp.ones_like(alive, dtype=_I32)
+            vals = jax.ops.segment_sum(jnp.where(alive, ones, 0), gid,
+                                       num_segments=cap_out)
+            results.append((vals.astype(jnp.int64) if jax.config.read("jax_enable_x64")
+                            else vals, jnp.ones(cap_out, bool)))
+            continue
+        data, valid = arg
+        contrib = alive & valid
+        cnt = contrib_count(valid)
+        if spec.func == "count":
+            results.append((cnt, jnp.ones(cap_out, bool)))
+        elif spec.func == "sum":
+            z = jnp.where(contrib, data, jnp.zeros((), data.dtype))
+            vals = _seg(z, gid, cap_out, "sum")
+            results.append((vals, cnt > 0))
+        elif spec.func in ("min", "max"):
+            big = _extreme(data.dtype, spec.func)
+            z = jnp.where(contrib, data, big)
+            vals = _seg(z, gid, cap_out, spec.func)
+            vals = jnp.where(cnt > 0, vals, jnp.zeros((), data.dtype))
+            results.append((vals, cnt > 0))
+        elif spec.func == "avg":
+            z = jnp.where(contrib, data, jnp.zeros((), data.dtype)).astype(
+                _float_dtype())
+            s = _seg(z, gid, cap_out, "sum")
+            vals = s / jnp.maximum(cnt, 1).astype(_float_dtype())
+            results.append((vals, cnt > 0))
+        elif spec.func == "stddev_samp":
+            zf = jnp.where(contrib, data, 0).astype(_float_dtype())
+            s = _seg(zf, gid, cap_out, "sum")
+            s2 = _seg(zf * zf, gid, cap_out, "sum")
+            nf = cnt.astype(_float_dtype())
+            var = (s2 - s * s / jnp.maximum(nf, 1.0)) / jnp.maximum(nf - 1.0, 1.0)
+            vals = jnp.sqrt(jnp.maximum(var, 0.0))
+            results.append((vals, cnt > 1))
+        else:
+            raise NotImplementedError(f"device agg {spec.func}")
+    return results
+
+
+def _float_dtype():
+    return jnp.float64 if jax.config.read("jax_enable_x64") else jnp.float32
+
+
+def _extreme(dtype, func: str):
+    info_fn = jnp.finfo if jnp.issubdtype(dtype, jnp.floating) else jnp.iinfo
+    return jnp.asarray(info_fn(dtype).max if func == "min" else info_fn(dtype).min,
+                       dtype=dtype)
+
+
+def group_representatives(gid: jax.Array, alive: jax.Array,
+                          data: jax.Array, valid: jax.Array,
+                          cap_out: int) -> tuple[jax.Array, jax.Array]:
+    """Per-group key value (all rows in a group share it): scatter any row."""
+    safe_gid = jnp.where(alive, gid, cap_out)
+    padded_vals = jnp.zeros(cap_out + 1, dtype=data.dtype).at[safe_gid].set(data)
+    padded_valid = jnp.zeros(cap_out + 1, dtype=bool).at[safe_gid].set(valid)
+    return padded_vals[:cap_out], padded_valid[:cap_out]
+
+
+def distinct_within_group(gid: jax.Array, alive: jax.Array,
+                          data: jax.Array, valid: jax.Array
+                          ) -> jax.Array:
+    """Alive-mask of one representative row per (gid, value) pair (for
+    COUNT/SUM DISTINCT): joint rank then first-occurrence selection."""
+    n = alive.shape[0]
+    pair_gid, _ = dense_rank([gid, jnp.where(valid, data, 0).astype(
+        data.dtype), (~valid).astype(_I32)],
+        [jnp.ones(n, bool), jnp.ones(n, bool), jnp.ones(n, bool)],
+        alive & valid)
+    first = jnp.full(n + 1, n, dtype=_I32).at[
+        jnp.where(alive & valid, pair_gid, n)].min(_iota(n))
+    return (alive & valid) & (first[pair_gid] == _iota(n))
+
+
+# ---------------------------------------------------------------------------
+# joins
+# ---------------------------------------------------------------------------
+
+def build_side(gid_right: jax.Array, alive_right: jax.Array
+               ) -> tuple[jax.Array, jax.Array]:
+    """Sort right-side gids (dead rows pushed to +inf); returns (sorted_gid, perm)."""
+    n = alive_right.shape[0]
+    key = jnp.where(alive_right, gid_right, jnp.iinfo(_I32).max)
+    sorted_gid, perm = lax.sort((key, _iota(n)), num_keys=1, is_stable=True)
+    return sorted_gid, perm
+
+
+def probe_counts(sorted_gid: jax.Array, probe_gid: jax.Array,
+                 probe_alive: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Per-probe-row match range in the sorted build side: (start, count)."""
+    lo = jnp.searchsorted(sorted_gid, probe_gid, side="left")
+    hi = jnp.searchsorted(sorted_gid, probe_gid, side="right")
+    cnt = jnp.where(probe_alive, hi - lo, 0)
+    return lo.astype(_I32), cnt.astype(_I32)
+
+
+def expand_join(lo: jax.Array, cnt: jax.Array, probe_alive: jax.Array,
+                cap_out: int) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Materialize (left_row, build_sorted_pos) pairs for an inner join.
+
+    cap_out must be >= total matches (caller host-syncs the total).
+    Returns (left_idx, build_pos, alive_out) each of length cap_out.
+    """
+    n = cnt.shape[0]
+    cum = jnp.cumsum(cnt)
+    total = cum[-1]
+    j = _iota(cap_out)
+    left_pos = jnp.searchsorted(cum, j, side="right").astype(_I32)
+    left_safe = jnp.minimum(left_pos, n - 1)
+    prev = jnp.where(left_safe > 0, cum[jnp.maximum(left_safe - 1, 0)], 0)
+    k = j - prev.astype(_I32)
+    build_pos = lo[left_safe] + k
+    alive_out = j < total
+    return left_safe, build_pos, alive_out

@@ -374,7 +374,9 @@ def join(left: Table, right: Table, kind: str,
     null_aware: NOT-IN semantics for anti joins — a NULL probe key or any NULL
     build key disqualifies (predicate is NULL, never TRUE).
     """
-    if kind == "cross":
+    if kind == "cross" or not left_keys:
+        # keyless joins (pure theta: residual-only condition) are a filtered
+        # cross product
         nl, nr = left.num_rows, right.num_rows
         left_idx = np.repeat(np.arange(nl, dtype=np.int64), nr)
         right_idx = np.tile(np.arange(nr, dtype=np.int64), nl)

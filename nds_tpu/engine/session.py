@@ -82,10 +82,24 @@ class Session:
         return Catalog({name: (sch[0], sch[1], self._est_rows.get(name, 1000))
                         for name, sch in self._schemas.items()})
 
-    def sql(self, query: str) -> Table:
+    def sql(self, query: str, backend: Optional[str] = None) -> Table:
+        """Run a query; backend "jax" (device) or "numpy" (host oracle).
+
+        Defaults to the config's use_jax flag — the device path is the
+        product path, the numpy path is the differential-validation oracle
+        (the role CPU-Spark plays against GPU-Spark in the reference,
+        nds/nds_validate.py).
+        """
         ast = parse_sql(query)
         planner = Planner(self._catalog())
         plan = planner.plan_query(ast)
+        use_jax = (backend == "jax") if backend else self.config.use_jax
+        if use_jax:
+            from .jax_backend import JaxExecutor, to_host
+            jexec = JaxExecutor(self.load_table)
+            result = to_host(jexec.execute(plan))
+            self.last_fallbacks = list(jexec.fallback_nodes)
+            return result
         executor = Executor(self.load_table)
         return executor.execute(plan)
 

@@ -1,0 +1,16 @@
+"""Multi-chip execution: device meshes + distributed relational primitives.
+
+The reference scales SQL via Spark's block shuffle between executors
+(SURVEY.md §2 parallelism table; no NCCL/MPI — JVM netty shuffle). The
+TPU-native equivalents here ride XLA collectives over ICI/DCN:
+
+- all_to_all      == shuffle / hash repartition
+- all_gather      == broadcast join of dimension tables
+- psum / psum_scatter == partial-aggregate merge
+- row-sharded arrays over a Mesh == table partitions across executors
+"""
+from .mesh import make_mesh, shard_spec  # noqa: F401
+from .dist_ops import (  # noqa: F401
+    shard_rows, broadcast_join_aggregate, repartition_by_key,
+    distributed_aggregate,
+)

@@ -1,0 +1,231 @@
+"""Distributed relational primitives over a device mesh (shard_map + collectives).
+
+Spark-shuffle analogs, TPU-native (SURVEY.md §2 last row):
+- `repartition_by_key`   all_to_all hash shuffle of row blocks
+- `broadcast_join_aggregate`  replicated build side (all_gather-free: the
+  dimension table is small, so it rides in replicated sharding), sharded
+  probe side, local partial aggregation, psum merge — the classic
+  "broadcast join + partial agg" Spark plan for star-schema queries.
+- `distributed_aggregate`  local partial agg -> all_gather of bounded
+  partials -> replicated final merge (Spark partial/final aggregate).
+
+Everything is a single jittable SPMD program: static shapes, masked rows,
+collectives inserted explicitly via shard_map.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from ..engine.jax_backend import kernels
+
+_I32 = jnp.int32
+
+
+def shard_rows(arrays: list[jax.Array], alive: jax.Array, mesh: Mesh
+               ) -> tuple[list[jax.Array], jax.Array]:
+    """Pad row count to a multiple of the mesh size and row-shard everything."""
+    n_shards = mesh.devices.size
+    axis = mesh.axis_names[0]
+    cap = int(alive.shape[0])
+    padded = ((cap + n_shards - 1) // n_shards) * n_shards
+    sharding = NamedSharding(mesh, P(axis))
+
+    def pad(x):
+        if x.shape[0] != padded:
+            fill = jnp.zeros((padded - x.shape[0],) + x.shape[1:], x.dtype)
+            x = jnp.concatenate([x, fill])
+        return jax.device_put(x, sharding)
+
+    return [pad(a) for a in arrays], pad(alive)
+
+
+def _fold_hash(key: jax.Array, n_shards: int) -> jax.Array:
+    """Deterministic shard assignment (Knuth multiplicative hash)."""
+    h = (key.astype(jnp.uint32) * jnp.uint32(2654435761)) >> 16
+    return (h % jnp.uint32(n_shards)).astype(_I32)
+
+
+def repartition_by_key(mesh: Mesh, per_pair_capacity: int):
+    """Build a jittable all_to_all hash-repartition over `mesh`.
+
+    Returned fn maps (columns, alive, key) — all row-sharded — to the same
+    pytree with every row now living on shard hash(key) % n_shards, plus an
+    int32 overflow counter (rows dropped because a (src,dst) block exceeded
+    per_pair_capacity; callers must size capacity so this stays 0).
+    """
+    axis = mesh.axis_names[0]
+    n_shards = mesh.devices.size
+
+    def local(cols, alive, key):
+        cap = alive.shape[0]
+        dest = jnp.where(alive, _fold_hash(key, n_shards), n_shards)
+        # rank of each row within its destination block
+        order = jnp.argsort(dest, stable=True)
+        dest_sorted = dest[order]
+        boundary = jnp.concatenate(
+            [jnp.ones(1, bool), dest_sorted[1:] != dest_sorted[:-1]])
+        pos_in_block = jnp.arange(cap, dtype=_I32) - \
+            jnp.maximum.accumulate(
+                jnp.where(boundary, jnp.arange(cap, dtype=_I32), 0))
+        slot_sorted = pos_in_block
+        overflow = jnp.sum((slot_sorted >= per_pair_capacity) &
+                           (dest_sorted < n_shards)).astype(_I32)
+        # scatter rows into [n_shards, per_pair_capacity] blocks
+        ok = (slot_sorted < per_pair_capacity) & (dest_sorted < n_shards)
+        flat = jnp.where(ok, dest_sorted * per_pair_capacity + slot_sorted,
+                         n_shards * per_pair_capacity)
+
+        def place(col_sorted):
+            buf = jnp.zeros((n_shards * per_pair_capacity + 1,),
+                            col_sorted.dtype)
+            return buf.at[flat].set(jnp.where(ok, col_sorted, 0)
+                                    )[:n_shards * per_pair_capacity]
+
+        out_cols = [place(c[order]) for c in cols]
+        out_alive = jnp.zeros(n_shards * per_pair_capacity + 1, bool).at[
+            flat].set(ok)[:n_shards * per_pair_capacity]
+        out_key = place(key[order])
+        # exchange: block b of this shard -> shard b
+        def exchange(x):
+            blocks = x.reshape((n_shards, per_pair_capacity) + x.shape[1:])
+            return lax.all_to_all(blocks, axis, split_axis=0, concat_axis=0
+                                  ).reshape((-1,) + x.shape[1:])
+        out_cols = [exchange(c) for c in out_cols]
+        out_alive = exchange(out_alive)
+        out_key = exchange(out_key)
+        overflow = lax.psum(overflow, axis)
+        return out_cols, out_alive, out_key, overflow
+
+    return shard_map(local, mesh=mesh,
+                     in_specs=(P(axis), P(axis), P(axis)),
+                     out_specs=(P(axis), P(axis), P(axis), P()))
+
+
+def distributed_aggregate(mesh: Mesh, n_partial: int, specs: list[str]):
+    """Partial-aggregate per shard, all_gather bounded partials, final merge.
+
+    specs: per-value aggregation kind, "sum"|"count"|"min"|"max".
+    Returned jittable fn: (group_key [sharded], valid, alive, values) ->
+    (group_keys [n_partial * n_shards], agg_values, out_alive) replicated.
+    """
+    axis = mesh.axis_names[0]
+
+    def local(key, valid, alive, values):
+        gid, _ = kernels.dense_rank([key], [valid], alive)
+        reps, rep_valid = kernels.group_representatives(
+            gid, alive, key, valid, n_partial)
+        partials = []
+        for spec, v in zip(specs, values):
+            if spec == "count":
+                data = jnp.where(alive & valid, 1, 0).astype(v.dtype)
+                partials.append(jax.ops.segment_sum(
+                    data, jnp.where(alive, gid, n_partial),
+                    num_segments=n_partial))
+            elif spec == "sum":
+                data = jnp.where(alive & valid, v, 0)
+                partials.append(jax.ops.segment_sum(
+                    data, jnp.where(alive, gid, n_partial),
+                    num_segments=n_partial))
+            elif spec in ("min", "max"):
+                ext = kernels._extreme(v.dtype, spec)
+                data = jnp.where(alive & valid, v, ext)
+                seg = jax.ops.segment_min if spec == "min" \
+                    else jax.ops.segment_max
+                partials.append(seg(data, jnp.where(alive, gid, n_partial),
+                                    num_segments=n_partial))
+            else:
+                raise ValueError(spec)
+        group_alive = rep_valid  # a slot is used iff some row scattered into it
+        # gather all shards' partials everywhere, merge locally (replicated)
+        g_keys = lax.all_gather(reps, axis, tiled=True)
+        g_alive = lax.all_gather(group_alive, axis, tiled=True)
+        g_partials = [lax.all_gather(p, axis, tiled=True) for p in partials]
+        m_gid, _ = kernels.dense_rank([g_keys], [g_alive], g_alive)
+        cap_out = g_keys.shape[0]
+        out_keys, out_alive = kernels.group_representatives(
+            m_gid, g_alive, g_keys, g_alive, cap_out)
+        merged = []
+        for spec, p in zip(specs, g_partials):
+            sg = jnp.where(g_alive, m_gid, cap_out)
+            if spec in ("sum", "count"):
+                merged.append(jax.ops.segment_sum(
+                    jnp.where(g_alive, p, 0), sg, num_segments=cap_out))
+            else:
+                ext = kernels._extreme(p.dtype, spec)
+                seg = jax.ops.segment_min if spec == "min" \
+                    else jax.ops.segment_max
+                merged.append(seg(jnp.where(g_alive, p, ext), sg,
+                                  num_segments=cap_out))
+        return out_keys, merged, out_alive
+
+    return shard_map(local, mesh=mesh,
+                     in_specs=(P(axis), P(axis), P(axis), P(axis)),
+                     out_specs=(P(), P(), P()))
+
+
+def broadcast_join_aggregate(mesh: Mesh, n_partial: int, specs: list[str]):
+    """The flagship star-schema step as ONE SPMD program.
+
+    Sharded fact side (probe), replicated dimension side (build, unique
+    keys assumed — PK side), filter mask applied, inner-join semantics,
+    grouped partial aggregation by a dimension attribute, psum-free
+    all_gather merge. This is the TPU-native shape of NDS power-run
+    queries (fact x dims -> group -> agg; e.g. reference query templates
+    joining store_sales to date_dim/item, SURVEY.md §0).
+
+    Returned jittable fn:
+      (fact_key, fact_mask, fact_alive, fact_values,
+       dim_key, dim_group, dim_alive) ->
+      (group_keys, agg_values, out_alive) replicated.
+    """
+    axis = mesh.axis_names[0]
+
+    def local(fact_key, fact_mask, fact_alive, fact_values,
+              dim_key, dim_group, dim_alive):
+        alive = fact_alive & fact_mask
+        # build: sort replicated dim keys once (same on every shard)
+        rcap = dim_key.shape[0]
+        bkey = jnp.where(dim_alive, dim_key, jnp.iinfo(fact_key.dtype).max)
+        sorted_key, perm = lax.sort((bkey, jnp.arange(rcap, dtype=_I32)),
+                                    num_keys=1, is_stable=True)
+        idx = jnp.searchsorted(sorted_key, fact_key)
+        idx = jnp.clip(idx, 0, rcap - 1)
+        matched = (sorted_key[idx] == fact_key) & alive
+        grp = dim_group[perm[idx]]
+        gid, _ = kernels.dense_rank([grp], [matched], matched)
+        reps, rep_alive = kernels.group_representatives(
+            gid, matched, grp, matched, n_partial)
+        partials = []
+        for spec, v in zip(specs, fact_values):
+            sg = jnp.where(matched, gid, n_partial)
+            if spec == "count":
+                partials.append(jax.ops.segment_sum(
+                    jnp.where(matched, 1, 0).astype(v.dtype), sg,
+                    num_segments=n_partial))
+            else:
+                partials.append(jax.ops.segment_sum(
+                    jnp.where(matched, v, 0), sg, num_segments=n_partial))
+        g_keys = lax.all_gather(reps, axis, tiled=True)
+        g_alive = lax.all_gather(rep_alive, axis, tiled=True)
+        g_partials = [lax.all_gather(p, axis, tiled=True) for p in partials]
+        m_gid, _ = kernels.dense_rank([g_keys], [g_alive], g_alive)
+        cap_out = g_keys.shape[0]
+        out_keys, out_alive = kernels.group_representatives(
+            m_gid, g_alive, g_keys, g_alive, cap_out)
+        merged = [jax.ops.segment_sum(jnp.where(g_alive, p, 0),
+                                      jnp.where(g_alive, m_gid, cap_out),
+                                      num_segments=cap_out)
+                  for p in g_partials]
+        return out_keys, merged, out_alive
+
+    return shard_map(local, mesh=mesh,
+                     in_specs=(P(axis), P(axis), P(axis), P(axis),
+                               P(), P(), P()),
+                     out_specs=(P(), P(), P()))

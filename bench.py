@@ -1,0 +1,84 @@
+"""Benchmark entry point: one JSON line for the driver.
+
+Current benchmark (round 1): a star-schema aggregate query (NDS power-run
+shape: fact x dimension join -> group -> agg; reference nds/nds_power.py
+times 103 such units per stream) over synthetic deterministic data, run on
+the default JAX platform (the real TPU chip under the driver) through the
+engine's JAX backend. Baseline = the same query through the numpy oracle
+backend on host CPU — the reference's CPU-vs-accelerator frame
+(nds/nds_validate.py compares exactly these two roles).
+
+Prints: {"metric", "value", "unit", "vs_baseline"} — vs_baseline > 1 means
+the device path beats the host-oracle path.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+N_FACT = 2_000_000
+N_DIM = 20_000
+REPEATS = 5
+
+QUERY = """
+SELECT d.grp, COUNT(*) AS cnt, SUM(f.qty) AS total_qty,
+       AVG(f.price) AS avg_price, MAX(f.price) AS max_price
+FROM fact f JOIN dim d ON f.fk = d.dk
+WHERE f.day BETWEEN 30 AND 120 AND f.qty > 5
+GROUP BY d.grp
+ORDER BY d.grp
+"""
+
+
+def build_session():
+    import pyarrow as pa
+
+    from nds_tpu.engine import Session
+
+    rng = np.random.default_rng(42)
+    fact = pa.table({
+        "fk": pa.array(rng.integers(0, N_DIM + 500, N_FACT), type=pa.int32()),
+        "qty": pa.array(rng.integers(1, 100, N_FACT), type=pa.int32()),
+        "price": pa.array(np.round(rng.uniform(0.5, 999.0, N_FACT), 2)
+                          .astype(np.float32)),
+        "day": pa.array(rng.integers(0, 365, N_FACT), type=pa.int32()),
+    })
+    dim = pa.table({
+        "dk": pa.array(np.arange(N_DIM), type=pa.int32()),
+        "grp": pa.array((np.arange(N_DIM) % 100).astype(np.int32)),
+    })
+    s = Session()
+    s.register_arrow("fact", fact)
+    s.register_arrow("dim", dim)
+    return s
+
+
+def timed(fn, repeats: int) -> float:
+    fn()  # warmup (compile + caches)
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main() -> None:
+    s = build_session()
+    t_jax = timed(lambda: s.sql(QUERY, backend="jax"), REPEATS)
+    t_oracle = timed(lambda: s.sql(QUERY, backend="numpy"), 3)
+    rows_per_sec = N_FACT / t_jax
+    print(json.dumps({
+        "metric": "star_agg_query_rows_per_sec",
+        "value": round(rows_per_sec, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(t_oracle / t_jax, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

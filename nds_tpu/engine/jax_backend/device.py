@@ -119,24 +119,27 @@ def to_host(dt: DTable, count: Optional[int] = None) -> Table:
 
 
 def _flatten_compound(c: DCol) -> DCol:
-    """Materialize a lazy-concat compound string column into a real dictionary."""
+    """Materialize a lazy-concat compound string column into a real dictionary.
+
+    String appends run once per *distinct* part-code tuple, not per row:
+    rows are first deduplicated over their stacked part codes.
+    """
     if c.parts is None:
         return c
-    part_strs = []
-    for p in c.parts:
-        codes = np.asarray(p.data)
-        valid = np.asarray(p.valid)
+    code_mat = np.stack([np.where(np.asarray(p.valid), np.asarray(p.data), -1)
+                         for p in c.parts], axis=1)
+    uniq_rows, inverse = np.unique(code_mat, axis=0, return_inverse=True)
+    joined = np.full(len(uniq_rows), "", dtype=object)
+    for j, p in enumerate(c.parts):
         d = p.dictionary if p.dictionary is not None else np.empty(0, dtype=object)
+        codes = uniq_rows[:, j]
         safe = np.clip(codes, 0, max(len(d) - 1, 0))
-        vals = d[safe] if len(d) else np.full(len(codes), "", dtype=object)
-        part_strs.append(np.where(valid, vals, ""))
-    joined = part_strs[0].astype(object)
-    for p in part_strs[1:]:
-        joined = np.asarray([a + b for a, b in zip(joined, p.astype(object))],
-                            dtype=object)
-    uniq, codes = np.unique(joined.astype(str), return_inverse=True)
-    return DCol("str", jnp.asarray(codes.astype(np.int32)), c.valid,
-                uniq.astype(object))
+        vals = np.where(codes >= 0,
+                        d[safe] if len(d) else "", "")
+        joined = np.asarray([a + b for a, b in zip(joined, vals)], dtype=object)
+    uniq, remap = np.unique(joined.astype(str), return_inverse=True)
+    codes = remap.astype(np.int32)[inverse]
+    return DCol("str", jnp.asarray(codes), c.valid, uniq.astype(object))
 
 
 def string_rank_lut(dictionary: Optional[np.ndarray]) -> np.ndarray:

@@ -219,19 +219,15 @@ class JaxExecutor:
     def _aggregate_one(self, node: AggregateNode, child: DTable,
                        keep: list[int]) -> DTable:
         group_cols = [self._eval(e, child) for e in node.group_exprs]
-        cap = child.capacity
         active = [group_cols[i] for i in keep]
         gid, num_groups_t = kernels.dense_rank(
             [rank_key(c) for c in active], [c.valid for c in active],
             child.alive)
-        num_groups = max(int(num_groups_t), 1 if not node.group_exprs else 0)
-        if not node.group_exprs and num_groups == 0:
-            # global aggregate over empty input still yields one row
-            gid = jnp.zeros(cap, _I32)
-            num_groups = 1
-            alive_for_agg = child.alive
-        else:
-            alive_for_agg = child.alive
+        num_groups = int(num_groups_t)
+        if not node.group_exprs:
+            # a global aggregate over empty input still yields one row
+            num_groups = max(num_groups, 1)
+        alive_for_agg = child.alive
         cap_out = bucket(max(num_groups, 1))
 
         out_cols: list[DCol] = []
@@ -483,21 +479,7 @@ def _concat_dtables(pieces: list[DTable], names: list[str]) -> DTable:
         cols = [_flatten_for_concat(p.cols[ci]) for p in pieces]
         dtype = cols[0].dtype
         if dtype == "str":
-            merged: dict[str, int] = {}
-            datas = []
-            for c in cols:
-                d = c.dictionary if c.dictionary is not None \
-                    else np.empty(0, dtype=object)
-                lut = np.empty(len(d), dtype=np.int32)
-                for i, v in enumerate(d):
-                    if v not in merged:
-                        merged[v] = len(merged)
-                    lut[i] = merged[v]
-                datas.append(jexprs._lut_gather(c.data, lut) if len(d)
-                             else jnp.zeros(len(c), _I32))
-            dictionary = np.empty(len(merged), dtype=object)
-            for v, i in merged.items():
-                dictionary[i] = v
+            dictionary, datas = jexprs._merge_branch_strings(cols)
             data = jnp.concatenate(datas)
             out_cols.append(DCol("str", data,
                                  jnp.concatenate([c.valid for c in cols]),

@@ -82,12 +82,11 @@ def _lut_gather(codes: jax.Array, lut: np.ndarray) -> jax.Array:
     return dlut[jnp.clip(codes, 0, dlut.shape[0] - 1)]
 
 
-def _merge_dicts(da: np.ndarray, db: np.ndarray
-                 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Common dictionary + per-side code remap LUTs (host)."""
+def _merge_dicts(*dicts: np.ndarray) -> tuple[np.ndarray, list[np.ndarray]]:
+    """Common dictionary + per-input code remap LUTs (host)."""
     seen: dict[str, int] = {}
     luts = []
-    for d in (da, db):
+    for d in dicts:
         lut = np.empty(len(d), dtype=np.int32)
         for i, v in enumerate(d):
             if v not in seen:
@@ -97,12 +96,12 @@ def _merge_dicts(da: np.ndarray, db: np.ndarray
     merged = np.empty(len(seen), dtype=object)
     for v, i in seen.items():
         merged[i] = v
-    return merged, luts[0], luts[1]
+    return merged, luts
 
 
 def _string_pair_keys(a: DCol, b: DCol) -> tuple[jax.Array, jax.Array]:
     """Comparable int keys for two string columns (merged lexicographic rank)."""
-    merged, la, lb = _merge_dicts(_dict(a), _dict(b))
+    merged, (la, lb) = _merge_dicts(_dict(a), _dict(b))
     ranks = string_rank_lut(merged)
     ka = _lut_gather(_lut_gather(a.data, la), ranks)
     kb = _lut_gather(_lut_gather(b.data, lb), ranks)
@@ -215,8 +214,9 @@ def _in_list(expr: BCall, table: DTable, sq) -> DCol:
         if not vals:
             out = jnp.zeros(a.data.shape, bool)
         else:
-            arr = jnp.asarray(vals).astype(a.data.dtype)
-            out = jnp.isin(a.data, arr)
+            arr = jnp.asarray(vals)
+            ct = jnp.promote_types(a.data.dtype, arr.dtype)
+            out = jnp.isin(a.data.astype(ct), arr.astype(ct))
     valid = a.valid
     if has_null:
         valid = valid & out
@@ -269,21 +269,12 @@ def _case(expr: BCall, table: DTable, sq) -> DCol:
 
 
 def _merge_branch_strings(cols: list[DCol]) -> tuple[np.ndarray, list]:
-    merged: dict[str, int] = {}
-    datas = []
-    for c in cols:
-        d = _dict(c)
-        lut = np.empty(len(d), dtype=np.int32)
-        for i, v in enumerate(d):
-            if v not in merged:
-                merged[v] = len(merged)
-            lut[i] = merged[v]
-        datas.append(_lut_gather(c.data, lut) if len(d)
-                     else jnp.zeros(len(c), jnp.int32))
-    out = np.empty(len(merged), dtype=object)
-    for v, i in merged.items():
-        out[i] = v
-    return out, datas
+    """Recode string columns into one shared dictionary (device codes)."""
+    merged, luts = _merge_dicts(*[_dict(c) for c in cols])
+    datas = [_lut_gather(c.data, lut) if len(lut)
+             else jnp.zeros(len(c), jnp.int32)
+             for c, lut in zip(cols, luts)]
+    return merged, datas
 
 
 def _coalesce(expr: BCall, table: DTable, sq) -> DCol:
@@ -309,7 +300,8 @@ def _nullif(expr: BCall, table: DTable, sq) -> DCol:
         ka, kb = _string_pair_keys(a, b)
         same = ka == kb
     else:
-        same = a.data == b.data.astype(a.data.dtype)
+        ct = jnp.promote_types(a.data.dtype, b.data.dtype)
+        same = a.data.astype(ct) == b.data.astype(ct)
     same = same & a.valid & b.valid
     return DCol(a.dtype, a.data, a.valid & ~same, a.dictionary, a.parts)
 

@@ -27,6 +27,8 @@ class Session:
         self._schemas: dict[str, tuple[list[str], list[str]]] = {}
         self._est_rows: dict[str, int] = {}
         self._cache: dict[str, Table] = {}
+        # device-backend fallback observability, reset per sql() call
+        self.last_fallbacks: list[str] = []
 
     # -- registration -------------------------------------------------------
     def register_arrow(self, name: str, table: pa.Table,
@@ -94,6 +96,7 @@ class Session:
         planner = Planner(self._catalog())
         plan = planner.plan_query(ast)
         use_jax = (backend == "jax") if backend else self.config.use_jax
+        self.last_fallbacks = []
         if use_jax:
             from .jax_backend import JaxExecutor, to_host
             jexec = JaxExecutor(self.load_table)

@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from ..engine.jax_backend import kernels
 
@@ -113,12 +113,19 @@ def distributed_aggregate(mesh: Mesh, n_partial: int, specs: list[str]):
 
     specs: per-value aggregation kind, "sum"|"count"|"min"|"max".
     Returned jittable fn: (group_key [sharded], valid, alive, values) ->
-    (group_keys [n_partial * n_shards], agg_values, out_alive) replicated.
+    (group_keys [n_partial * n_shards], agg_values, out_alive, overflow)
+    replicated; overflow counts rows in groups beyond n_partial (callers
+    must size n_partial so it stays 0 — otherwise results are partial).
     """
     axis = mesh.axis_names[0]
 
     def local(key, valid, alive, values):
         gid, _ = kernels.dense_rank([key], [valid], alive)
+        cap = alive.shape[0]
+        # rows in groups beyond the partial capacity would be silently
+        # dropped by the out-of-range scatter — count them instead
+        overflow = jnp.sum((alive & (gid >= n_partial) & (gid < cap))
+                           .astype(_I32))
         reps, rep_valid = kernels.group_representatives(
             gid, alive, key, valid, n_partial)
         partials = []
@@ -163,11 +170,11 @@ def distributed_aggregate(mesh: Mesh, n_partial: int, specs: list[str]):
                     else jax.ops.segment_max
                 merged.append(seg(jnp.where(g_alive, p, ext), sg,
                                   num_segments=cap_out))
-        return out_keys, merged, out_alive
+        return out_keys, merged, out_alive, lax.psum(overflow, axis)
 
     return shard_map(local, mesh=mesh,
                      in_specs=(P(axis), P(axis), P(axis), P(axis)),
-                     out_specs=(P(), P(), P()))
+                     out_specs=(P(), P(), P(), P()), check_vma=False)
 
 
 def broadcast_join_aggregate(mesh: Mesh, n_partial: int, specs: list[str]):
@@ -183,7 +190,8 @@ def broadcast_join_aggregate(mesh: Mesh, n_partial: int, specs: list[str]):
     Returned jittable fn:
       (fact_key, fact_mask, fact_alive, fact_values,
        dim_key, dim_group, dim_alive) ->
-      (group_keys, agg_values, out_alive) replicated.
+      (group_keys, agg_values, out_alive, overflow) replicated; overflow
+      counts rows in groups beyond n_partial (must be 0 for exact results).
     """
     axis = mesh.axis_names[0]
 
@@ -200,6 +208,9 @@ def broadcast_join_aggregate(mesh: Mesh, n_partial: int, specs: list[str]):
         matched = (sorted_key[idx] == fact_key) & alive
         grp = dim_group[perm[idx]]
         gid, _ = kernels.dense_rank([grp], [matched], matched)
+        cap = matched.shape[0]
+        overflow = jnp.sum((matched & (gid >= n_partial) & (gid < cap))
+                           .astype(_I32))
         reps, rep_alive = kernels.group_representatives(
             gid, matched, grp, matched, n_partial)
         partials = []
@@ -223,9 +234,9 @@ def broadcast_join_aggregate(mesh: Mesh, n_partial: int, specs: list[str]):
                                       jnp.where(g_alive, m_gid, cap_out),
                                       num_segments=cap_out)
                   for p in g_partials]
-        return out_keys, merged, out_alive
+        return out_keys, merged, out_alive, lax.psum(overflow, axis)
 
     return shard_map(local, mesh=mesh,
                      in_specs=(P(axis), P(axis), P(axis), P(axis),
                                P(), P(), P()),
-                     out_specs=(P(), P(), P()))
+                     out_specs=(P(), P(), P(), P()), check_vma=False)

@@ -6,11 +6,15 @@ multi-chip sharding logic is exercised on a virtual device mesh
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax
 
+# jax may already be imported (the image's sitecustomize registers a TPU
+# plugin at interpreter start and captures JAX_PLATFORMS before we run), so
+# force the platform through the config system too.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)

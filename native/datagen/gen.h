@@ -1,0 +1,95 @@
+// Core definitions for the NDS-TPU native data generator.
+//
+// Counterpart of the reference's patched TPC-DS dsdgen C toolkit
+// (reference nds/tpcds-gen/patches/code.patch + Makefile): same CLI
+// semantics (-scale/-parallel/-child/-update, pipe-delimited output,
+// per-chunk files) but an original counter-based design: every value is a
+// pure function of (table, column, logical row index, scale), so any chunk
+// of any table can be generated independently and the union over chunks is
+// identical for every -parallel split. No shared state, no patching.
+#pragma once
+
+#include <cstdint>
+#include <string>
+
+enum ColKind { K_ID, K_ID64, K_INT, K_INT32, K_DEC, K_STR, K_DATE };
+
+struct Col {
+    const char* name;
+    ColKind kind;
+    int precision;
+    int scale;
+    int length;
+    bool not_null;
+};
+
+struct TableDef {
+    const char* name;
+    const Col* cols;
+    int ncols;
+};
+
+// ---------------------------------------------------------------------------
+// counter-based RNG: splitmix64 over a (salt, stream, counter) key.
+// Deterministic and O(1)-seekable — the property that makes -parallel/-child
+// chunking exact (the reference toolkit instead re-seeds per chunk).
+// ---------------------------------------------------------------------------
+static inline uint64_t mix64(uint64_t x) {
+    x += 0x9E3779B97F4A7C15ull;
+    x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ull;
+    x = (x ^ (x >> 27)) * 0x94D049BB133111EBull;
+    return x ^ (x >> 31);
+}
+
+static inline uint64_t rng_at(uint64_t salt, uint64_t stream, uint64_t ctr) {
+    return mix64(salt ^ mix64(stream ^ mix64(ctr)));
+}
+
+// uniform integer in [lo, hi] (inclusive)
+static inline int64_t rng_range(uint64_t r, int64_t lo, int64_t hi) {
+    if (hi <= lo) return lo;
+    return lo + (int64_t)(r % (uint64_t)(hi - lo + 1));
+}
+
+static inline double rng_unit(uint64_t r) {
+    return (double)(r >> 11) * (1.0 / 9007199254740992.0);  // 53-bit
+}
+
+// ---------------------------------------------------------------------------
+// calendar: civil-date math. TPC-DS date surrogate keys are Julian day
+// numbers; d_date_sk 2415022 == 1900-01-02 (first date_dim row).
+// ---------------------------------------------------------------------------
+static const int64_t JULIAN_1900_01_02 = 2415022;
+static const int64_t DATE_DIM_ROWS = 73049;  // 1900-01-02 .. 2100-01-01
+
+// days since civil epoch 1970-01-01 from y/m/d (Howard Hinnant's algorithm)
+static inline int64_t days_from_civil(int y, int m, int d) {
+    y -= m <= 2;
+    const int era = (y >= 0 ? y : y - 399) / 400;
+    const unsigned yoe = (unsigned)(y - era * 400);
+    const unsigned doy = (unsigned)((153 * (m + (m > 2 ? -3 : 9)) + 2) / 5 + d - 1);
+    const unsigned doe = yoe * 365 + yoe / 4 - yoe / 100 + doy;
+    return (int64_t)era * 146097 + (int64_t)doe - 719468;
+}
+
+struct Civil { int y, m, d; };
+
+static inline Civil civil_from_days(int64_t z) {
+    z += 719468;
+    const int64_t era = (z >= 0 ? z : z - 146096) / 146097;
+    const unsigned doe = (unsigned)(z - era * 146097);
+    const unsigned yoe = (doe - doe / 1460 + doe / 36524 - doe / 146096) / 365;
+    const int64_t y = (int64_t)yoe + era * 400;
+    const unsigned doy = doe - (365 * yoe + yoe / 4 - yoe / 100);
+    const unsigned mp = (5 * doy + 2) / 153;
+    const unsigned d = doy - (153 * mp + 2) / 5 + 1;
+    const unsigned m = mp + (mp < 10 ? 3 : -9);
+    return {(int)(y + (m <= 2)), (int)m, (int)d};
+}
+
+// epoch-days (1970) of the first date_dim row
+static const int64_t EPOCH_1900_01_02 = -25566;  // days_from_civil(1900,1,2)
+
+static inline int64_t sk_to_epoch_days(int64_t date_sk) {
+    return EPOCH_1900_01_02 + (date_sk - JULIAN_1900_01_02);
+}

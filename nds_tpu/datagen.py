@@ -1,0 +1,171 @@
+"""Data-generation CLI: drives the native ndsdgen generator.
+
+Capability parity with the reference data-gen front-end
+(reference nds/nds_gen_data.py): local process-parallel generation
+(generate_data_local :183-244 forks one dsdgen per chunk), per-table output
+directories, incremental --range generation (:155-174), --update refresh
+sets (:220-229 in nds_bench.py), and the delete-date table placement
+(move_delete_date_tables :119-127). The cluster path is a host-list fanout
+instead of a Hadoop MR job (SURVEY.md §2 parallelism table).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import subprocess
+import sys
+
+SOURCE_TABLES = [
+    "call_center", "catalog_page", "catalog_returns", "catalog_sales",
+    "customer", "customer_address", "customer_demographics", "date_dim",
+    "dbgen_version", "household_demographics", "income_band", "inventory",
+    "item", "promotion", "reason", "ship_mode", "store", "store_returns",
+    "store_sales", "time_dim", "warehouse", "web_page", "web_returns",
+    "web_sales", "web_site",
+]
+MAINTENANCE_TABLES = [
+    "s_purchase_lineitem", "s_purchase", "s_catalog_order", "s_web_order",
+    "s_catalog_order_lineitem", "s_web_order_lineitem", "s_store_returns",
+    "s_catalog_returns", "s_web_returns", "s_inventory", "delete",
+    "inventory_delete",
+]
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BINARY = os.path.join(_REPO_ROOT, "native", "bin", "ndsdgen")
+
+
+def check_build(binary: str = DEFAULT_BINARY) -> str:
+    """Locate the native generator, building it if the tree is present
+    (reference check.py:47-66 checks the jar/dsdgen build)."""
+    if os.path.exists(binary):
+        return binary
+    src_dir = os.path.join(_REPO_ROOT, "native", "datagen")
+    if os.path.isdir(src_dir):
+        subprocess.run(["make"], cwd=src_dir, check=True,
+                       capture_output=True)
+        if os.path.exists(binary):
+            return binary
+    raise FileNotFoundError(
+        f"ndsdgen binary not found at {binary}; run `make` in native/datagen")
+
+
+def valid_range(r: str, parallel: int) -> tuple[int, int]:
+    """Parse --range 'first,last' (1-based chunk indexes, reference
+    check.py:88-123)."""
+    try:
+        first, last = (int(x) for x in r.split(","))
+    except ValueError:
+        raise ValueError(f"bad range {r!r}: expected 'first,last'")
+    if not (1 <= first <= last <= parallel):
+        raise ValueError(f"range {r!r} outside 1..{parallel}")
+    return first, last
+
+
+def generate_data_local(data_dir: str, scale: float, parallel: int,
+                        chunk_range: tuple[int, int] | None = None,
+                        update: int = 0,
+                        binary: str | None = None,
+                        overwrite: bool = False) -> None:
+    """Fork one generator process per chunk and lay out per-table dirs."""
+    binary = binary or check_build()
+    if os.path.exists(data_dir):
+        if not overwrite and os.listdir(data_dir):
+            raise FileExistsError(
+                f"{data_dir} is not empty; pass overwrite to replace")
+        shutil.rmtree(data_dir, ignore_errors=True)
+    work = os.path.join(data_dir, "_raw_")
+    os.makedirs(work, exist_ok=True)
+
+    first, last = chunk_range if chunk_range else (1, parallel)
+    procs = []
+    for child in range(first, last + 1):
+        cmd = [binary, "-scale", str(scale), "-dir", work,
+               "-parallel", str(parallel), "-child", str(child)]
+        if update:
+            cmd += ["-update", str(update)]
+        procs.append((child, subprocess.Popen(cmd)))
+    failed = [c for c, p in procs if p.wait() != 0]
+    if failed:
+        raise RuntimeError(f"generator chunks failed: {failed}")
+
+    tables = MAINTENANCE_TABLES if update else SOURCE_TABLES
+    for table in tables:
+        tdir = os.path.join(data_dir, table)
+        os.makedirs(tdir, exist_ok=True)
+        if parallel > 1:
+            for child in range(first, last + 1):
+                src = os.path.join(work, f"{table}_{child}_{parallel}.dat")
+                # small tables leave some chunks empty; don't ship those
+                if os.path.exists(src) and os.path.getsize(src) > 0:
+                    os.rename(src, os.path.join(tdir, os.path.basename(src)))
+        else:
+            src = os.path.join(work, f"{table}.dat")
+            if os.path.exists(src):
+                os.rename(src, os.path.join(tdir, f"{table}.dat"))
+    shutil.rmtree(work, ignore_errors=True)
+
+    # verify non-empty output (reference nds_gen_data.py:199-206)
+    for table in tables:
+        tdir = os.path.join(data_dir, table)
+        if not os.listdir(tdir):
+            raise RuntimeError(f"no output produced for table {table}")
+
+
+def generate_data_hosts(data_dir: str, scale: float, parallel: int,
+                        hosts: list[str], update: int = 0) -> None:
+    """Multi-host fanout: assign chunk ranges to hosts via ssh.
+
+    The TPU-native replacement for the reference's Hadoop MR wrapper
+    (GenTable.java): no cluster framework, one ssh per host with a chunk
+    range; hosts share a filesystem or sync afterwards.
+    """
+    n = len(hosts)
+    procs = []
+    for i, host in enumerate(hosts):
+        first = parallel * i // n + 1
+        last = parallel * (i + 1) // n
+        if first > last:
+            continue
+        sub = (f"python -m nds_tpu.datagen local {data_dir} --scale {scale} "
+               f"--parallel {parallel} --range {first},{last} --overwrite")
+        if update:
+            sub += f" --update {update}"
+        procs.append(subprocess.Popen(["ssh", host, sub]))
+    failed = [p.args for p in procs if p.wait() != 0]
+    if failed:
+        raise RuntimeError(f"host generation failed: {failed}")
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="nds_tpu.datagen",
+        description="Generate NDS benchmark data with the native generator")
+    p.add_argument("mode", choices=["local", "hosts"],
+                   help="local: fork processes; hosts: ssh fanout")
+    p.add_argument("data_dir")
+    p.add_argument("--scale", type=float, default=1.0)
+    p.add_argument("--parallel", type=int, default=os.cpu_count() or 1)
+    p.add_argument("--range", dest="range_", default=None,
+                   help="chunk subrange 'first,last' for incremental runs")
+    p.add_argument("--update", type=int, default=0,
+                   help="generate refresh (maintenance) set K instead")
+    p.add_argument("--overwrite", action="store_true")
+    p.add_argument("--hosts", default="",
+                   help="comma-separated host list for hosts mode")
+    a = p.parse_args(argv)
+
+    rng = valid_range(a.range_, a.parallel) if a.range_ else None
+    if a.mode == "local":
+        generate_data_local(a.data_dir, a.scale, a.parallel, rng,
+                            a.update, overwrite=a.overwrite)
+    else:
+        hosts = [h for h in a.hosts.split(",") if h]
+        if not hosts:
+            p.error("hosts mode requires --hosts")
+        generate_data_hosts(a.data_dir, a.scale, a.parallel, hosts, a.update)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,159 @@
+"""Native generator tests: build, determinism under chunking, schema fit.
+
+The reference had no tests for its datagen layer (SURVEY.md §4); these cover
+the properties the framework depends on: (a) -parallel/-child splits change
+nothing but file boundaries, (b) every table parses under the registry
+schema, (c) update sets produce the 12 maintenance tables.
+"""
+import os
+import subprocess
+
+import pyarrow as pa
+import pyarrow.csv as pa_csv
+import pytest
+
+from nds_tpu import datagen
+from nds_tpu.schema import all_schemas
+
+SF = 0.002
+
+
+@pytest.fixture(scope="module")
+def binary():
+    return datagen.check_build()
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory, binary):
+    d = tmp_path_factory.mktemp("sf_tiny")
+    datagen.generate_data_local(str(d), SF, parallel=2, overwrite=True)
+    return str(d)
+
+
+def _read_table(path: str, table: str) -> pa.Table:
+    schema = all_schemas()[table].arrow_schema(use_decimal=True)
+    convert = pa_csv.ConvertOptions(
+        column_types={f.name: f.type for f in schema},
+        null_values=[""], strings_can_be_null=True)
+    read = pa_csv.ReadOptions(column_names=[f.name for f in schema])
+    parse = pa_csv.ParseOptions(delimiter="|")
+    return pa_csv.read_csv(path, read_options=read, parse_options=parse,
+                           convert_options=convert)
+
+
+def test_all_source_tables_parse_under_schema(data_dir):
+    for table in datagen.SOURCE_TABLES:
+        if table == "dbgen_version":
+            continue
+        tdir = os.path.join(data_dir, table)
+        files = sorted(os.listdir(tdir))
+        assert files, table
+        total = 0
+        for f in files:
+            t = _read_table(os.path.join(tdir, f), table)
+            total += t.num_rows
+        assert total > 0, table
+
+
+def test_not_null_columns_have_no_nulls(data_dir):
+    for table in ("store_sales", "item", "customer"):
+        tdir = os.path.join(data_dir, table)
+        sch = all_schemas()[table]
+        for f in os.listdir(tdir):
+            t = _read_table(os.path.join(tdir, f), table)
+            for col in sch.columns:
+                if not col.nullable:
+                    assert t.column(col.name).null_count == 0, \
+                        f"{table}.{col.name}"
+
+
+def test_chunking_determinism(binary, tmp_path):
+    """parallel=1 vs parallel=3 must produce the same multiset of rows."""
+    one = tmp_path / "p1"
+    three = tmp_path / "p3"
+    one.mkdir(), three.mkdir()
+    subprocess.run([binary, "-scale", "0.001", "-dir", str(one),
+                    "-table", "store_sales"], check=True)
+    for child in (1, 2, 3):
+        subprocess.run([binary, "-scale", "0.001", "-dir", str(three),
+                        "-parallel", "3", "-child", str(child),
+                        "-table", "store_sales"], check=True)
+    rows_one = sorted((one / "store_sales.dat").read_text().splitlines())
+    rows_three = []
+    for child in (1, 2, 3):
+        rows_three += (three / f"store_sales_{child}_3.dat"
+                       ).read_text().splitlines()
+    assert rows_one == sorted(rows_three)
+    assert len(rows_one) > 100
+
+
+def test_returns_reference_sales(data_dir):
+    """Every store_returns row must match a store_sales (item, ticket) line."""
+    sales_dir = os.path.join(data_dir, "store_sales")
+    ret_dir = os.path.join(data_dir, "store_returns")
+    sold = set()
+    for f in os.listdir(sales_dir):
+        t = _read_table(os.path.join(sales_dir, f), "store_sales")
+        for item, ticket in zip(t.column("ss_item_sk").to_pylist(),
+                                t.column("ss_ticket_number").to_pylist()):
+            sold.add((item, ticket))
+    checked = 0
+    for f in os.listdir(ret_dir):
+        t = _read_table(os.path.join(ret_dir, f), "store_returns")
+        for item, ticket in zip(t.column("sr_item_sk").to_pylist(),
+                                t.column("sr_ticket_number").to_pylist()):
+            assert (item, ticket) in sold
+            checked += 1
+    assert checked > 10
+
+
+def test_date_dim_calendar(data_dir):
+    files = os.listdir(os.path.join(data_dir, "date_dim"))
+    t = pa.concat_tables(
+        _read_table(os.path.join(data_dir, "date_dim", f), "date_dim")
+        for f in sorted(files))
+    assert t.num_rows == 73049
+    import datetime
+    sks = t.column("d_date_sk").to_pylist()
+    dates = t.column("d_date").to_pylist()
+    years = t.column("d_year").to_pylist()
+    dows = t.column("d_dow").to_pylist()
+    names = t.column("d_day_name").to_pylist()
+    assert sks[0] == 2415022 and dates[0] == datetime.date(1900, 1, 2)
+    # spot-check a known date: 2000-03-01
+    idx = dates.index(datetime.date(2000, 3, 1))
+    assert years[idx] == 2000
+    assert names[idx] == ["Sunday", "Monday", "Tuesday", "Wednesday",
+                          "Thursday", "Friday", "Saturday"][dows[idx]]
+    assert dows[idx] == 3  # 2000-03-01 was a Wednesday
+    assert sks == list(range(2415022, 2415022 + 73049))
+
+
+def test_update_set(tmp_path, binary):
+    d = tmp_path / "upd"
+    datagen.generate_data_local(str(d), 0.001, parallel=1, update=1,
+                                overwrite=True)
+    for table in datagen.MAINTENANCE_TABLES:
+        files = os.listdir(d / table)
+        assert files, table
+        t = _read_table(str(d / table / files[0]), table)
+        assert t.num_rows > 0
+    # delete-date tables: 3 DATE1<DATE2 tuples (maintenance substitution)
+    t = _read_table(str(d / "delete" / "delete.dat"), "delete")
+    assert t.num_rows == 3
+    for d1, d2 in zip(t.column("date1").to_pylist(),
+                      t.column("date2").to_pylist()):
+        assert d1 < d2
+
+
+def test_scaling_monotonic(binary, tmp_path):
+    import math
+    out = {}
+    for sf in (0.001, 0.01):
+        d = tmp_path / f"sf{sf}"
+        d.mkdir()
+        subprocess.run([binary, "-scale", str(sf), "-dir", str(d),
+                        "-table", "web_sales"], check=True)
+        out[sf] = len((d / "web_sales.dat").read_text().splitlines())
+    ratio = out[0.01] / out[0.001]
+    assert 5 < ratio < 20 and not math.isnan(ratio)

@@ -233,6 +233,9 @@ static const char* SM_TYPES[] = {"EXPRESS","NEXT DAY","OVERNIGHT","REGULAR","TWO
 static const char* SM_CARRIERS[] = {"UPS","FEDEX","AIRBORNE","USPS","DHL",
     "TBS","ZHOU","ZOUROS","MSC","LATVIAN","ALLIANCE","GREAT EASTERN",
     "DIAMOND","RUPEKSA","ORIENTAL","BARIAN","BOXBUNDLES","GERMA","HARMSTORF","PRIVATECARRIER"};
+// digit syllables (TPC-DS-style number words) — store names and the like
+static const char* SYLLABLES[] = {"ought","able","pri","ese","anti","cally",
+    "ation","eing","n st","bar"};
 static const char* WORDS[] = {"as","his","with","have","from","they","been",
     "about","important","results","right","different","general","good",
     "small","large","national","young","early","possible","social","still",
@@ -514,6 +517,9 @@ static void generic_value(const TableDef& t, int ci, int64_t row,
     }
     if (ends_with(n, "_login")) { L.null_(); return; }
     if (ends_with(n, "_url")) { L.s("http://www.foo.com"); return; }
+    if (!strcmp(n, "s_store_name") || !strcmp(n, "w_warehouse_name")) {
+        L.s(POOL(r, SYLLABLES)); return;
+    }
     if (ends_with(n, "_name") && c.length <= 60) {
         std::string v = POOL(r, WORDS); v += POOL(mix64(r), WORDS);
         L.s(v.substr(0, c.length ? c.length : 50)); return;

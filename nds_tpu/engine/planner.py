@@ -303,6 +303,7 @@ class Planner:
         scope = Scope(scope_entries, parent=outer)
 
         conjuncts = _split_and(sel.where) if sel.where is not None else []
+        conjuncts = conjuncts + _or_implied_conjuncts(conjuncts)
         edges, residuals, subq_conjs = [], [], []
         for c in conjuncts:
             if _has_subquery(c):
@@ -1098,6 +1099,33 @@ def _split_and(node) -> list:
     if isinstance(node, A.BinOp) and node.op == "and":
         return _split_and(node.left) + _split_and(node.right)
     return [node]
+
+
+def _split_or(node) -> list:
+    if isinstance(node, A.BinOp) and node.op == "or":
+        return _split_or(node.left) + _split_or(node.right)
+    return [node]
+
+
+def _or_implied_conjuncts(conjuncts: list) -> list:
+    """Predicates common to every branch of an OR conjunct are implied by it
+    and can be lifted to top level: (A ∧ x) ∨ (A ∧ y) ⇒ A. TPC-DS-style
+    queries (e.g. reference query13/query48 templates) bury their equi-join
+    conditions inside OR blocks; without lifting, those joins plan as cross
+    products. The OR itself stays as a residual filter, so this is purely
+    an implication — never a rewrite."""
+    implied = []
+    for c in conjuncts:
+        branches = _split_or(c)
+        if len(branches) < 2:
+            continue
+        branch_maps = [{_ast_key(p): p for p in _split_and(b)}
+                       for b in branches]
+        common = set(branch_maps[0])
+        for bm in branch_maps[1:]:
+            common &= set(bm)
+        implied.extend(branch_maps[0][k] for k in sorted(common))
+    return implied
 
 
 def _and_all(parts):

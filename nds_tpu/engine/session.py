@@ -7,6 +7,7 @@ from Arrow/Parquet and `sql()` parses, plans, and executes on the JAX engine.
 """
 from __future__ import annotations
 
+import os
 from typing import Callable, Optional
 
 import pyarrow as pa
@@ -53,6 +54,34 @@ class Session:
 
         def load(ds=dataset):
             return arrow_bridge.from_arrow(ds.to_table())
+        self._loaders[name] = load
+        self._cache.pop(name, None)
+
+    def register_csv(self, name: str, path: str, schema: pa.Schema,
+                     est_rows: Optional[int] = None,
+                     delimiter: str = "|") -> None:
+        """Register a pipe-delimited file or directory of files lazily
+        (the reference registers raw CSV as Spark temp views with explicit
+        schema, nds_power.py:78-105)."""
+        import pyarrow.csv as pa_csv
+
+        files = ([os.path.join(path, f) for f in sorted(os.listdir(path))]
+                 if os.path.isdir(path) else [path])
+        names, dtypes = arrow_bridge.engine_schema(schema)
+        self._schemas[name] = (names, dtypes)
+        self._est_rows[name] = est_rows if est_rows is not None else 10000
+
+        def load(files=tuple(files), schema=schema):
+            convert = pa_csv.ConvertOptions(
+                column_types={f.name: f.type for f in schema},
+                null_values=[""], strings_can_be_null=True)
+            read = pa_csv.ReadOptions(column_names=[f.name for f in schema])
+            parse = pa_csv.ParseOptions(delimiter=delimiter)
+            parts = [pa_csv.read_csv(f, read_options=read,
+                                     parse_options=parse,
+                                     convert_options=convert)
+                     for f in files if os.path.getsize(f) > 0]
+            return arrow_bridge.from_arrow(pa.concat_tables(parts))
         self._loaders[name] = load
         self._cache.pop(name, None)
 

@@ -1,0 +1,208 @@
+"""Power-run workload: execute one query stream serially, timing each query.
+
+Capability parity with the reference power runner (reference
+nds/nds_power.py): stream parsing on ``-- start`` markers with the
+two-statement splits (gen_sql_from_stream :49-76), table registration from
+raw data or the Parquet warehouse (setup_tables :78-105), per-query timing
+under a BenchReport with JSON summaries (run_one_query :124-134 +
+PysparkBenchReport), output-column sanitization (ensure_valid_column_names
+:136-173), a CSV time log with ``Power Start/End/Test Time`` sentinel rows
+(:281-299), and a --sub_queries subset (:175-180).
+"""
+from __future__ import annotations
+
+import argparse
+import csv
+import os
+import re
+import sys
+import time
+from collections import OrderedDict
+
+from .engine import Session
+from .config import EngineConfig
+from .report import BenchReport
+from .schema import get_maintenance_schemas, get_schemas
+from .streams import SPECIAL_TEMPLATES, split_special_query
+
+_START_RE = re.compile(
+    r"^--\s*start query (\d+) using template query(\d+)\.tpl", re.IGNORECASE)
+
+
+def gen_sql_from_stream(stream_text: str) -> "OrderedDict[str, str]":
+    """Split a stream file into {query_name: sql} preserving order."""
+    queries: "OrderedDict[str, str]" = OrderedDict()
+    current: list[str] = []
+    number = None
+    for line in stream_text.splitlines():
+        m = _START_RE.match(line.strip())
+        if m:
+            if number is not None:
+                _emit(queries, number, current)
+            number = int(m.group(2))
+            current = []
+        else:
+            current.append(line)
+    if number is not None:
+        _emit(queries, number, current)
+    return queries
+
+
+def _emit(queries, number, lines):
+    sql = "\n".join(lines).strip()
+    name = f"query{number}"
+    if number in SPECIAL_TEMPLATES:
+        for part_name, part_sql in split_special_query(name, sql):
+            queries[part_name] = part_sql
+    else:
+        queries[name] = sql.rstrip(";")
+
+
+def setup_tables(session: Session, input_prefix: str, input_format: str,
+                 use_decimal: bool = True,
+                 maintenance: bool = False) -> dict[str, float]:
+    """Register the 24 source tables (plus maintenance staging when asked).
+
+    Returns per-table registration times (the reference times view creation,
+    nds_power.py:94-104).
+    """
+    times: dict[str, float] = {}
+    schemas = dict(get_schemas(use_decimal))
+    if maintenance:
+        schemas.update(get_maintenance_schemas(use_decimal))
+    for name, sch in schemas.items():
+        path = os.path.join(input_prefix, name)
+        if not os.path.exists(path):
+            continue
+        t0 = time.perf_counter()
+        if input_format == "csv":
+            session.register_csv(name, path,
+                                 sch.arrow_schema(use_decimal=False))
+        elif input_format == "parquet":
+            session.register_parquet(name, path)
+        else:
+            raise ValueError(f"unsupported input format {input_format}")
+        times[name] = time.perf_counter() - t0
+    return times
+
+
+_VALID_COL = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+
+def ensure_valid_column_names(names: list[str]) -> list[str]:
+    """Sanitize/dedupe output column names for parquet writing (reference
+    nds_power.py:136-173)."""
+    out: list[str] = []
+    seen: dict[str, int] = {}
+    for i, n in enumerate(names):
+        if not n or not _VALID_COL.match(n):
+            n = f"column_{i}"
+        base = n
+        if base in seen:
+            seen[base] += 1
+            n = f"{base}_{seen[base]}"
+        else:
+            seen[base] = 0
+        out.append(n)
+    return out
+
+
+def run_one_query(session: Session, sql: str, query_name: str,
+                  output_prefix: str | None, output_format: str,
+                  backend: str | None = None):
+    statements = [s for s in sql.split(";") if s.strip()]
+    result = None
+    for stmt in statements:
+        result = session.sql(stmt, backend=backend)
+    if output_prefix and result is not None:
+        import pyarrow.parquet as pq
+        from .engine.arrow_bridge import to_arrow
+        table = to_arrow(result)
+        table = table.rename_columns(
+            ensure_valid_column_names(table.column_names))
+        out_dir = os.path.join(output_prefix, query_name)
+        os.makedirs(out_dir, exist_ok=True)
+        pq.write_table(table, os.path.join(out_dir, "part-0.parquet"))
+    return result
+
+
+def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
+                     input_format: str = "parquet",
+                     output_prefix: str | None = None,
+                     output_format: str = "parquet",
+                     json_summary_folder: str | None = None,
+                     sub_queries: list[str] | None = None,
+                     property_file: str | None = None,
+                     backend: str | None = None,
+                     keep_sc: bool = False) -> list[tuple[str, int, int, int]]:
+    """Run every query in the stream; returns (name, start_ms, end_ms, ms).
+
+    The CSV time log layout (query name, start, end, elapsed + the
+    ``Power Start/End/Test Time`` sentinel rows) matches the reference's
+    (nds_power.py:281-299) so the orchestrator can scrape either.
+    """
+    config = EngineConfig.from_property_file(property_file)
+    session = Session(config)
+    setup_tables(session, input_prefix, input_format)
+
+    with open(stream_path) as f:
+        query_dict = gen_sql_from_stream(f.read())
+    if sub_queries:
+        query_dict = OrderedDict(
+            (k, v) for k, v in query_dict.items()
+            if k in sub_queries or k.rstrip("_part12") in sub_queries)
+
+    rows: list[tuple[str, int, int, int]] = []
+    power_start = int(time.time() * 1000)
+    for name, sql in query_dict.items():
+        report = BenchReport(config, app_name=f"NDS-TPU {name}")
+        q_start = int(time.time() * 1000)
+        report.report_on(run_one_query, session, sql, name,
+                         output_prefix, output_format, backend)
+        for fb in session.last_fallbacks:
+            report.record_task_failure(f"device fallback: {fb}")
+        elapsed = report.summary["queryTimes"][-1]
+        rows.append((name, q_start, q_start + elapsed, elapsed))
+        status = report.summary["queryStatus"][-1]
+        print(f"{name}: {status} in {elapsed} ms", flush=True)
+        if json_summary_folder:
+            report.write_summary(
+                name, prefix=os.path.join(json_summary_folder, "power"))
+    power_end = int(time.time() * 1000)
+
+    os.makedirs(os.path.dirname(time_log) or ".", exist_ok=True)
+    with open(time_log, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["query", "start_time", "end_time", "time"])
+        w.writerow(["Power Start Time", power_start, "", ""])
+        for r in rows:
+            w.writerow(r)
+        w.writerow(["Power End Time", power_end, "", ""])
+        w.writerow(["Power Test Time", "", "", power_end - power_start])
+    return rows
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="nds_tpu.power")
+    p.add_argument("input_prefix", help="data root (per-table dirs)")
+    p.add_argument("query_stream_file")
+    p.add_argument("time_log")
+    p.add_argument("--input_format", default="parquet",
+                   choices=["parquet", "csv"])
+    p.add_argument("--output_prefix", default=None)
+    p.add_argument("--output_format", default="parquet")
+    p.add_argument("--json_summary_folder", default=None)
+    p.add_argument("--sub_queries", default=None,
+                   help="comma-separated query subset, e.g. query1,query3")
+    p.add_argument("--property_file", default=None)
+    p.add_argument("--backend", default=None, choices=["jax", "numpy"])
+    a = p.parse_args(argv)
+    sub = a.sub_queries.split(",") if a.sub_queries else None
+    run_query_stream(a.input_prefix, a.query_stream_file, a.time_log,
+                     a.input_format, a.output_prefix, a.output_format,
+                     a.json_summary_folder, sub, a.property_file, a.backend)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,91 @@
+"""Per-query benchmark reports: status, timing, environment capture.
+
+Capability parity with the reference's observability layer (reference
+nds/PysparkBenchReport.py): wrap any callable, capture redacted env vars
+(:71-72), engine configuration (the Spark-conf analog), wall time, a status
+taxonomy — Completed / CompletedWithTaskFailures / Failed — and exceptions
+(report_on :59-107); write ``{prefix}-{query}-{startTime}.json`` summaries
+whose filename format downstream tooling depends on (write_summary
+:109-122). The "task failure" analog on this engine is a device-backend
+node falling back to the host oracle (collected per query), plus any
+partial-shard errors once multi-host execution lands.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import traceback
+from dataclasses import asdict, is_dataclass
+from typing import Any, Callable
+
+
+REDACT_MARKERS = ("TOKEN", "SECRET", "PASSWORD")
+
+
+def _redacted_env() -> dict[str, str]:
+    out = {}
+    for k, v in os.environ.items():
+        if any(m in k.upper() for m in REDACT_MARKERS):
+            v = "*********(redacted)"
+        out[k] = v
+    return out
+
+
+class BenchReport:
+    """Collects one benchmark run's summary (one query, one table load...)."""
+
+    def __init__(self, engine_config: Any = None, app_name: str = ""):
+        cfg = {}
+        if is_dataclass(engine_config):
+            cfg = {k: str(v) for k, v in asdict(engine_config).items()}
+        elif isinstance(engine_config, dict):
+            cfg = {k: str(v) for k, v in engine_config.items()}
+        self.summary = {
+            "env": {
+                "envVars": _redacted_env(),
+                "engineConf": cfg,
+                "appName": app_name,
+            },
+            "queryStatus": [],
+            "exceptions": [],
+            "startTime": None,
+            "queryTimes": [],
+            "taskFailures": [],
+        }
+
+    def report_on(self, fn: Callable, *args, **kwargs):
+        """Run fn, recording wall time and status. Returns fn's result
+        (or None on failure)."""
+        self.summary["startTime"] = int(time.time() * 1000)
+        start = time.perf_counter()
+        result = None
+        try:
+            result = fn(*args, **kwargs)
+            status = "Completed"
+        except Exception:
+            status = "Failed"
+            self.summary["exceptions"].append(traceback.format_exc())
+        elapsed = int((time.perf_counter() - start) * 1000)
+        if status == "Completed" and self.summary["taskFailures"]:
+            status = "CompletedWithTaskFailures"
+        self.summary["queryStatus"].append(status)
+        self.summary["queryTimes"].append(elapsed)
+        return result
+
+    def record_task_failure(self, detail: str) -> None:
+        """Analog of the reference's Scala TaskFailureListener feed
+        (reference nds/jvm_listener TaskFailureListener.scala): failures
+        that did not abort the query but must surface in the status."""
+        self.summary["taskFailures"].append(detail)
+
+    def write_summary(self, query_name: str, prefix: str = "") -> str | None:
+        if not prefix:
+            return None
+        os.makedirs(os.path.dirname(prefix) or ".", exist_ok=True)
+        # filename format consumed by reporting pipelines
+        # (reference PysparkBenchReport.py:116-118)
+        path = f"{prefix}-{query_name}-{self.summary['startTime']}.json"
+        with open(path, "w") as f:
+            json.dump(self.summary, f, indent=2)
+        return path

@@ -1,0 +1,196 @@
+"""Query-stream generation: the dsqgen-equivalent tool layer.
+
+Capability parity with the reference stream front-end (reference
+nds/nds_gen_query_stream.py): instantiate the 99 query templates into N
+permuted streams seeded by -rngseed (generate_query_streams :42-89), write
+``query_{i}.sql`` files whose queries carry ``-- start query N using
+template queryX.tpl`` markers (the power runner splits on these,
+nds_power.py:49-76), and split the four two-statement templates
+(14, 23, 24, 39) into _part1/_part2 units (split_special_query :91-103).
+
+Template parameterization is original: each .tpl starts with ``-- define
+[NAME] = <expr>`` lines (uniform_int, choice, year, etc.) evaluated with a
+counter-based RNG keyed by (rngseed, template, param, stream), so any
+stream can be generated independently and reproducibly.
+"""
+from __future__ import annotations
+
+import argparse
+import hashlib
+import os
+import re
+import struct
+import sys
+from typing import Callable
+
+TEMPLATE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "templates")
+
+# the four templates whose body holds two independent statements
+# (reference nds_gen_query_stream.py:91-103)
+SPECIAL_TEMPLATES = (14, 23, 24, 39)
+
+_DEFINE_RE = re.compile(r"^--\s*define\s+\[(\w+)\]\s*=\s*(.+?)\s*$")
+
+
+def _rng(rngseed: int, template: int, param: str, stream: int) -> int:
+    h = hashlib.sha256(
+        f"{rngseed}/{template}/{param}/{stream}".encode()).digest()
+    return struct.unpack("<Q", h[:8])[0]
+
+
+def _eval_param(expr: str, r: int):
+    """Evaluate a parameter expression with randomness r.
+
+    Supported forms:
+      uniform_int(lo, hi)        inclusive integer
+      choice('a', 'b', ...)      uniform pick
+      choice_n(k, 'a', ...)      k distinct picks, comma-joined as quoted list
+      dist_month()               1..12
+    """
+    m = re.match(r"^uniform_int\((-?\d+),\s*(-?\d+)\)$", expr)
+    if m:
+        lo, hi = int(m.group(1)), int(m.group(2))
+        return str(lo + r % (hi - lo + 1))
+    m = re.match(r"^choice\((.+)\)$", expr)
+    if m:
+        opts = _split_args(m.group(1))
+        return _unquote(opts[r % len(opts)])
+    m = re.match(r"^choice_n\((\d+),\s*(.+)\)$", expr)
+    if m:
+        k = int(m.group(1))
+        opts = _split_args(m.group(2))
+        picked = []
+        rr = r
+        pool = list(opts)
+        for _ in range(min(k, len(pool))):
+            picked.append(pool.pop(rr % len(pool)))
+            rr = (rr * 6364136223846793005 + 1442695040888963407) % (1 << 64)
+        return ", ".join(picked)
+    m = re.match(r"^dist_month\(\)$", expr)
+    if m:
+        return str(1 + r % 12)
+    raise ValueError(f"unsupported parameter expression: {expr!r}")
+
+
+def _split_args(s: str) -> list[str]:
+    out, depth, cur, in_q = [], 0, "", False
+    for ch in s:
+        if ch == "'" and depth == 0:
+            in_q = not in_q
+            cur += ch
+        elif ch == "," and depth == 0 and not in_q:
+            out.append(cur.strip())
+            cur = ""
+        else:
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+            cur += ch
+    if cur.strip():
+        out.append(cur.strip())
+    return out
+
+
+def _unquote(s: str) -> str:
+    return s[1:-1] if len(s) >= 2 and s[0] == "'" and s[-1] == "'" else s
+
+
+def load_template(number: int, template_dir: str = TEMPLATE_DIR
+                  ) -> tuple[dict[str, str], str]:
+    """Read queryN.tpl -> (param defs, body)."""
+    path = os.path.join(template_dir, f"query{number}.tpl")
+    defs: dict[str, str] = {}
+    body_lines: list[str] = []
+    with open(path) as f:
+        for line in f:
+            m = _DEFINE_RE.match(line.strip())
+            if m:
+                defs[m.group(1)] = m.group(2)
+            else:
+                body_lines.append(line.rstrip("\n"))
+    return defs, "\n".join(body_lines).strip()
+
+
+def instantiate(number: int, stream: int, rngseed: int,
+                template_dir: str = TEMPLATE_DIR) -> str:
+    defs, body = load_template(number, template_dir)
+    for name, expr in defs.items():
+        value = _eval_param(expr, _rng(rngseed, number, name, stream))
+        body = body.replace(f"[{name}]", str(value))
+    leftover = re.search(r"\[([A-Z_]+)\]", body)
+    if leftover:
+        raise ValueError(
+            f"query{number}.tpl: unbound parameter [{leftover.group(1)}]")
+    return body
+
+
+def available_templates(template_dir: str = TEMPLATE_DIR) -> list[int]:
+    out = []
+    for f in os.listdir(template_dir):
+        m = re.match(r"^query(\d+)\.tpl$", f)
+        if m:
+            out.append(int(m.group(1)))
+    return sorted(out)
+
+
+def _permutation(numbers: list[int], stream: int, rngseed: int) -> list[int]:
+    """Deterministic per-stream ordering; stream 0 runs in template order
+    (the reference gets permutations from dsqgen's internal tables)."""
+    if stream == 0:
+        return list(numbers)
+    order = list(numbers)
+    r = _rng(rngseed, 0, "permutation", stream)
+    for i in range(len(order) - 1, 0, -1):
+        r = (r * 6364136223846793005 + 1442695040888963407) % (1 << 64)
+        j = r % (i + 1)
+        order[i], order[j] = order[j], order[i]
+    return order
+
+
+def generate_query_streams(output_dir: str, streams: int, rngseed: int,
+                           template_dir: str = TEMPLATE_DIR,
+                           template: int | None = None) -> list[str]:
+    """Write query_0.sql .. query_{streams-1}.sql (or a single template's
+    instantiations when ``template`` is given, mirroring dsqgen -template)."""
+    os.makedirs(output_dir, exist_ok=True)
+    numbers = [template] if template else available_templates(template_dir)
+    paths = []
+    for s in range(streams):
+        path = os.path.join(output_dir, f"query_{s}.sql")
+        with open(path, "w") as f:
+            for n in _permutation(numbers, s, rngseed):
+                sql = instantiate(n, s, rngseed, template_dir)
+                f.write(f"-- start query {n} using template query{n}.tpl\n")
+                f.write(sql.rstrip().rstrip(";") + ";\n\n")
+        paths.append(path)
+    return paths
+
+
+def split_special_query(query_name: str, sql: str) -> list[tuple[str, str]]:
+    """Split a two-statement special query into _part1/_part2 units."""
+    stmts = [s.strip() for s in sql.split(";") if s.strip()]
+    if len(stmts) <= 1:
+        return [(query_name, sql)]
+    return [(f"{query_name}_part{i + 1}", stmt)
+            for i, stmt in enumerate(stmts)]
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="nds_tpu.streams")
+    p.add_argument("output_dir")
+    p.add_argument("--streams", type=int, default=1)
+    p.add_argument("--rngseed", type=int, required=True,
+                   help="seed (the bench uses the load-test end timestamp)")
+    p.add_argument("--template", type=int, default=None)
+    p.add_argument("--template_dir", default=TEMPLATE_DIR)
+    a = p.parse_args(argv)
+    paths = generate_query_streams(a.output_dir, a.streams, a.rngseed,
+                                   a.template_dir, a.template)
+    print("\n".join(paths))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

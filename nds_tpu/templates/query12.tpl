@@ -1,0 +1,17 @@
+-- define [CATEGORY] = choice_n(3, 'Books', 'Children', 'Electronics', 'Home', 'Jewelry', 'Men', 'Music', 'Shoes', 'Sports', 'Women')
+-- define [YEAR] = uniform_int(1998, 2002)
+-- define [MONTH] = uniform_int(1, 7)
+SELECT i_item_id, i_item_desc, i_category, i_class, i_current_price,
+       SUM(ws_ext_sales_price) AS itemrevenue,
+       SUM(ws_ext_sales_price) * 100 /
+         SUM(SUM(ws_ext_sales_price)) OVER (PARTITION BY i_class)
+         AS revenueratio
+FROM web_sales, item, date_dim
+WHERE ws_item_sk = i_item_sk
+  AND i_category IN ([CATEGORY])
+  AND ws_sold_date_sk = d_date_sk
+  AND d_date BETWEEN CAST('[YEAR]-0[MONTH]-01' AS DATE)
+                 AND CAST('[YEAR]-0[MONTH]-01' AS DATE) + INTERVAL 30 DAYS
+GROUP BY i_item_id, i_item_desc, i_category, i_class, i_current_price
+ORDER BY i_category, i_class, i_item_id, i_item_desc, revenueratio
+LIMIT 100

@@ -1,0 +1,48 @@
+-- define [YEAR] = uniform_int(1998, 2002)
+-- define [MS1] = choice('S','M','D','W','U')
+-- define [MS2] = choice('S','M','D','W','U')
+-- define [MS3] = choice('S','M','D','W','U')
+-- define [ES1] = choice('Primary','Secondary','College','2 yr Degree','4 yr Degree')
+-- define [ES2] = choice('Primary','Secondary','College','2 yr Degree','4 yr Degree')
+-- define [ES3] = choice('Primary','Secondary','College','2 yr Degree','4 yr Degree')
+-- define [STATES1] = choice_n(3, 'TN','SC','GA','AL','KY','VA','NC','TX','OH','MI')
+-- define [STATES2] = choice_n(3, 'IL','IN','IA','KS','MO','NE','MN','WI','AR','OK')
+-- define [STATES3] = choice_n(3, 'CA','OR','WA','NV','AZ','NM','UT','CO','ID','MT')
+SELECT AVG(ss_quantity) AS avg_qty,
+       AVG(ss_ext_sales_price) AS avg_esp,
+       AVG(ss_ext_wholesale_cost) AS avg_ewc,
+       SUM(ss_ext_wholesale_cost) AS sum_ewc
+FROM store_sales, store, customer_demographics,
+     household_demographics, customer_address, date_dim
+WHERE s_store_sk = ss_store_sk
+  AND ss_sold_date_sk = d_date_sk AND d_year = [YEAR]
+  AND ((ss_hdemo_sk = hd_demo_sk
+        AND cd_demo_sk = ss_cdemo_sk
+        AND cd_marital_status = '[MS1]'
+        AND cd_education_status = '[ES1]'
+        AND ss_sales_price BETWEEN 100.00 AND 150.00
+        AND hd_dep_count = 3)
+    OR (ss_hdemo_sk = hd_demo_sk
+        AND cd_demo_sk = ss_cdemo_sk
+        AND cd_marital_status = '[MS2]'
+        AND cd_education_status = '[ES2]'
+        AND ss_sales_price BETWEEN 50.00 AND 100.00
+        AND hd_dep_count = 1)
+    OR (ss_hdemo_sk = hd_demo_sk
+        AND cd_demo_sk = ss_cdemo_sk
+        AND cd_marital_status = '[MS3]'
+        AND cd_education_status = '[ES3]'
+        AND ss_sales_price BETWEEN 150.00 AND 200.00
+        AND hd_dep_count = 1))
+  AND ((ss_addr_sk = ca_address_sk
+        AND ca_country = 'United States'
+        AND ca_state IN ([STATES1])
+        AND ss_net_profit BETWEEN 100 AND 200)
+    OR (ss_addr_sk = ca_address_sk
+        AND ca_country = 'United States'
+        AND ca_state IN ([STATES2])
+        AND ss_net_profit BETWEEN 150 AND 300)
+    OR (ss_addr_sk = ca_address_sk
+        AND ca_country = 'United States'
+        AND ca_state IN ([STATES3])
+        AND ss_net_profit BETWEEN 50 AND 250))

@@ -1,0 +1,178 @@
+"""Result validation: differential comparison of two query-output trees.
+
+Capability parity with the reference validator (reference
+nds/nds_validate.py): per-query compare of two output dirs with a row-count
+gate then row-by-row comparison (compare_results :48-114), sorting on
+non-float columns first when --ignore_ordering (collect_results :116-144),
+epsilon comparison for floats/decimals with NaN == NaN (compare :194-215),
+the query78 ratio-column carve-out of ±0.01001 (:146-192), the q65 skip and
+q67-under-floats skip (iterate_queries :231-244), and writing
+``queryValidationStatus`` Pass/Fail/NotAttempted back into the JSON
+summaries (update_summary :262-296).
+
+Here the two trees are typically the JAX device backend vs the numpy host
+oracle (the reference compares GPU-Spark vs CPU-Spark).
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import math
+import os
+import sys
+
+import pyarrow.parquet as pq
+
+from .power import gen_sql_from_stream
+
+DEFAULT_EPSILON = 0.0001
+Q78_EPSILON = 0.01001
+
+SKIP_ALWAYS = ("query65",)          # nondeterministic under ties (ref :231)
+SKIP_WITH_FLOATS = ("query67",)     # rank over floats (ref :237)
+
+
+def _is_float_type(t) -> bool:
+    import pyarrow as pa
+    return (pa.types.is_floating(t) or pa.types.is_decimal(t))
+
+
+def _read_output(path: str):
+    files = sorted(glob.glob(os.path.join(path, "*.parquet")))
+    if not files:
+        return None
+    tables = [pq.read_table(f) for f in files]
+    import pyarrow as pa
+    return pa.concat_tables(tables)
+
+
+def compare(expected, actual, epsilon: float = DEFAULT_EPSILON) -> bool:
+    """Scalar compare with float epsilon and NaN == NaN (ref :194-215)."""
+    if expected is None or actual is None:
+        return expected is None and actual is None
+    if isinstance(expected, float) or isinstance(actual, float):
+        fe, fa = float(expected), float(actual)
+        if math.isnan(fe) or math.isnan(fa):
+            return math.isnan(fe) and math.isnan(fa)
+        if fe == fa:
+            return True
+        denom = max(abs(fe), abs(fa), 1e-30)
+        return abs(fe - fa) / denom < epsilon or abs(fe - fa) < epsilon
+    return expected == actual
+
+
+def _ratio_column_index(names: list[str]) -> int | None:
+    for i, n in enumerate(names):
+        if "ratio" in n.lower():
+            return i
+    return None
+
+
+def row_equal(row_e, row_a, query_name: str, names: list[str]) -> bool:
+    ratio_idx = _ratio_column_index(names) if query_name.startswith("query78") \
+        else None
+    for i, (e, a) in enumerate(zip(row_e, row_a)):
+        eps = Q78_EPSILON if i == ratio_idx else DEFAULT_EPSILON
+        if not compare(e, a, eps):
+            return False
+    return True
+
+
+def collect_rows(table, ignore_ordering: bool):
+    cols = [table.column(i).to_pylist() for i in range(table.num_columns)]
+    rows = list(zip(*cols)) if cols else []
+    if ignore_ordering:
+        float_cols = {i for i, f in enumerate(table.schema)
+                      if _is_float_type(f.type)}
+        def key(row):
+            return tuple(
+                (v is None, "" if v is None else str(v))
+                for i, v in enumerate(row) if i not in float_cols)
+        rows.sort(key=key)
+    return rows
+
+
+def compare_results(path_expected: str, path_actual: str, query_name: str,
+                    ignore_ordering: bool = False,
+                    epsilon: float = DEFAULT_EPSILON) -> bool:
+    te = _read_output(os.path.join(path_expected, query_name))
+    ta = _read_output(os.path.join(path_actual, query_name))
+    if te is None or ta is None:
+        print(f"{query_name}: missing output "
+              f"(expected={te is not None}, actual={ta is not None})")
+        return False
+    if te.num_rows != ta.num_rows:
+        print(f"{query_name}: row count differs "
+              f"{te.num_rows} vs {ta.num_rows}")
+        return False
+    rows_e = collect_rows(te, ignore_ordering)
+    rows_a = collect_rows(ta, ignore_ordering)
+    for i, (re_, ra) in enumerate(zip(rows_e, rows_a)):
+        if not row_equal(re_, ra, query_name, te.column_names):
+            print(f"{query_name}: row {i} differs\n  e: {re_}\n  a: {ra}")
+            return False
+    return True
+
+
+def iterate_queries(path_expected: str, path_actual: str,
+                    query_names: list[str], ignore_ordering: bool = False,
+                    use_floats: bool = True) -> dict[str, str]:
+    """Compare every query; returns {name: Pass|Fail|NotAttempted}."""
+    status: dict[str, str] = {}
+    for name in query_names:
+        base = name.split("_part")[0]
+        if base in SKIP_ALWAYS or (use_floats and base in SKIP_WITH_FLOATS):
+            status[name] = "NotAttempted"
+            continue
+        ok = compare_results(path_expected, path_actual, name,
+                             ignore_ordering)
+        status[name] = "Pass" if ok else "Fail"
+    return status
+
+
+def update_summary(json_summary_folder: str, status: dict[str, str]) -> None:
+    """Write queryValidationStatus into the power-run JSON summaries
+    (reference :262-296)."""
+    for path in glob.glob(os.path.join(json_summary_folder, "power-*.json")):
+        base = os.path.basename(path)
+        parts = base.split("-")
+        if len(parts) < 3:
+            continue
+        qname = "-".join(parts[1:-1])
+        if qname not in status:
+            continue
+        with open(path) as f:
+            summary = json.load(f)
+        summary["queryValidationStatus"] = [status[qname]]
+        with open(path, "w") as f:
+            json.dump(summary, f, indent=2)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="nds_tpu.validate")
+    p.add_argument("expected", help="output dir of the oracle run")
+    p.add_argument("actual", help="output dir of the device run")
+    p.add_argument("query_stream_file")
+    p.add_argument("--ignore_ordering", action="store_true")
+    p.add_argument("--json_summary_folder", default=None)
+    p.add_argument("--use_decimal", action="store_true",
+                   help="affects only the q67 skip policy")
+    a = p.parse_args(argv)
+    with open(a.query_stream_file) as f:
+        names = list(gen_sql_from_stream(f.read()))
+    status = iterate_queries(a.expected, a.actual, names, a.ignore_ordering,
+                             use_floats=not a.use_decimal)
+    if a.json_summary_folder:
+        update_summary(a.json_summary_folder, status)
+    failed = [n for n, s in status.items() if s == "Fail"]
+    for n, s in status.items():
+        print(f"{n}: {s}")
+    print(f"{len([s for s in status.values() if s == 'Pass'])} passed, "
+          f"{len(failed)} failed, "
+          f"{len([s for s in status.values() if s == 'NotAttempted'])} skipped")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,71 @@
+"""End-to-end slice: datagen -> stream gen -> power run -> validation.
+
+This is the framework's minimum end-to-end test (SURVEY.md §7: "datagen SF
+small -> schema load -> engine executes -> power-runner times it -> report
+CSV"), run on both backends with the validator as the oracle check —
+the reference could only do this against a live Spark cluster.
+"""
+import csv
+import os
+
+import pytest
+
+from nds_tpu import datagen, streams, validate
+from nds_tpu.power import gen_sql_from_stream, run_query_stream
+
+SUBSET = ["query1", "query3", "query42", "query96"]
+
+
+@pytest.fixture(scope="module")
+def env(tmp_path_factory):
+    root = tmp_path_factory.mktemp("e2e")
+    data = root / "data"
+    datagen.generate_data_local(str(data), 0.001, parallel=2, overwrite=True)
+    stream_dir = root / "streams"
+    streams.generate_query_streams(str(stream_dir), streams=1, rngseed=777)
+    return root, str(data), str(stream_dir / "query_0.sql")
+
+
+def test_stream_file_parses(env):
+    _, _, stream = env
+    with open(stream) as f:
+        queries = gen_sql_from_stream(f.read())
+    nums = streams.available_templates()
+    assert len(queries) >= len(nums)
+    assert all(q.startswith("query") for q in queries)
+
+
+def test_power_run_and_validate(env):
+    root, data, stream = env
+    out_np = str(root / "out_np")
+    out_jax = str(root / "out_jax")
+    rows = run_query_stream(data, stream, str(root / "time_np.csv"),
+                            input_format="csv", backend="numpy",
+                            output_prefix=out_np,
+                            json_summary_folder=str(root / "json"),
+                            sub_queries=SUBSET)
+    assert [r[0] for r in rows] == SUBSET
+    run_query_stream(data, stream, str(root / "time_jax.csv"),
+                     input_format="csv", backend="jax",
+                     output_prefix=out_jax, sub_queries=SUBSET)
+    status = validate.iterate_queries(out_np, out_jax, SUBSET,
+                                      ignore_ordering=True)
+    assert all(s == "Pass" for s in status.values()), status
+
+    # CSV time log sentinel rows (reference nds_power.py:281-299 format)
+    with open(root / "time_np.csv") as f:
+        log = list(csv.reader(f))
+    labels = [r[0] for r in log]
+    assert labels[0] == "query"
+    assert "Power Start Time" in labels and "Power End Time" in labels
+    assert "Power Test Time" in labels
+
+    # JSON summaries exist with the prefix-query-startTime naming
+    summaries = os.listdir(root / "json")
+    assert any(s.startswith("power-query1-") for s in summaries)
+
+    # validation status written back into summaries
+    validate.update_summary(str(root / "json"), status)
+    import json
+    with open(root / "json" / sorted(summaries)[0]) as f:
+        assert json.load(f)["queryValidationStatus"] in (["Pass"],)

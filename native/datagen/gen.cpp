@@ -387,6 +387,30 @@ static uint64_t table_salt(const char* name) {
     return h;
 }
 
+static int g_update = 0;
+
+// business-id (char16) of row `k` of a dimension table — the same formula
+// generic_value uses for the dimension's own *_id column, so staging
+// business-id references join back to real dimension rows
+static std::string dim_business_id(const char* target, int64_t k) {
+    return char16_id((uint64_t)k + table_salt(target) % 997);
+}
+
+struct IdRefRule { const char* suffix; const char* target; };
+static const IdRefRule STAGING_ID_RULES[] = {
+    {"_item_id", "item"}, {"_promotion_id", "promotion"},
+    {"_store_id", "store"}, {"_customer_id", "customer"},
+    {"_warehouse_id", "warehouse"}, {"_ship_mode_id", "ship_mode"},
+    {"_shipmode_id", "ship_mode"}, {"_call_center_id", "call_center"},
+    {"_web_site_id", "web_site"}, {"_web_page_id", "web_page"},
+    {"_catalog_page_id", "catalog_page"}, {"_reason_id", "reason"},
+};
+
+// new refresh orders must not collide with base order numbers
+static int64_t staging_order_base() {
+    return 100000000LL + (int64_t)g_update * 10000000LL;
+}
+
 static bool is_null(uint64_t salt, int ci, int64_t row, const Col& c) {
     if (c.not_null) return false;
     return rng_at(salt, 0xA11ull * (ci + 1), (uint64_t)row) % 25 == 0;
@@ -399,6 +423,34 @@ static void generic_value(const TableDef& t, int ci, int64_t row,
     const char* n = c.name;
     // primary surrogate key: first column of every dimension
     if (ci == 0 && (c.kind == K_ID || c.kind == K_ID64)) { L.i(row + 1); return; }
+    // staging (s_*) structural columns: order/lineitem alignment + id refs
+    if (!strncmp(t.name, "s_", 2)) {
+        if (!strcmp(n, "purc_purchase_id") || !strcmp(n, "cord_order_id") ||
+            !strcmp(n, "word_order_id")) { L.i(staging_order_base() + row); return; }
+        if (!strcmp(n, "plin_purchase_id")) { L.i(staging_order_base() + row / SS_AVG_LINES); return; }
+        if (!strcmp(n, "plin_line_number")) { L.i(row % SS_AVG_LINES + 1); return; }
+        if (!strcmp(n, "clin_order_id")) { L.i(staging_order_base() + row / CS_AVG_LINES); return; }
+        if (!strcmp(n, "clin_line_number")) { L.i(row % CS_AVG_LINES + 1); return; }
+        if (!strcmp(n, "wlin_order_id")) { L.i(staging_order_base() + row / WS_AVG_LINES); return; }
+        if (!strcmp(n, "wlin_line_number")) { L.i(row % WS_AVG_LINES + 1); return; }
+        if (!strcmp(n, "sret_ticket_number")) { L.i(1 + (int64_t)(r % (uint64_t)orders_of("store_sales"))); return; }
+        if (!strcmp(n, "cret_order_id")) { L.i(1 + (int64_t)(r % (uint64_t)orders_of("catalog_sales"))); return; }
+        if (!strcmp(n, "wret_order_id")) { L.i(1 + (int64_t)(r % (uint64_t)orders_of("web_sales"))); return; }
+        if (!strcmp(n, "sret_purchase_id")) { L.i(1 + (int64_t)(r % (uint64_t)orders_of("store_sales"))); return; }
+        if (!strcmp(n, "cret_line_number")) { L.i(1 + (int64_t)(r % CS_AVG_LINES)); return; }
+        if (!strcmp(n, "wret_line_number")) { L.i(1 + (int64_t)(r % WS_AVG_LINES)); return; }
+        if (!strcmp(n, "sret_line_number")) { L.i(1 + (int64_t)(r % SS_AVG_LINES)); return; }
+        if (c.kind == K_STR && c.length == 16) {
+            for (const auto& rule : STAGING_ID_RULES) {
+                if (ends_with(n, rule.suffix)) {
+                    if (!c.not_null && r % 25 == 0) { L.null_(); return; }
+                    int64_t nrows = row_count(rule.target, g_scale);
+                    L.s(dim_business_id(rule.target, (int64_t)(mix64(r) % (uint64_t)nrows)));
+                    return;
+                }
+            }
+        }
+    }
     if (is_null(salt, ci, row, c)) { L.null_(); return; }
     if (c.kind == K_ID || c.kind == K_ID64) {
         int64_t nrows = fk_rows(n);
@@ -527,6 +579,9 @@ static void generic_value(const TableDef& t, int ci, int64_t row,
     if (c.length == 1) { L.s(r % 2 ? "Y" : "N"); return; }
     if (ends_with(n, "_date")) {  // char(10) staging dates
         L.date(sk_to_epoch_days(rnd_date_sk(r))); return;
+    }
+    if (ends_with(n, "_time")) {  // char(10) staging time-of-day (seconds)
+        L.i((int64_t)(r % 86400)); return;
     }
     L.s(words_text(r, c.length ? c.length : 60));
 }
@@ -752,6 +807,7 @@ static int avg_lines_of(const char* sales) {
 static void generate_table(const char* name, double sf, int parallel,
                            int child, int update, FILE* f) {
     g_scale = sf;
+    g_update = update;
     const TableDef* t = find_table(name);
     Line L;
     uint64_t salt = table_salt(name) ^ (update ? mix64(0xDEADull + update) : 0);

@@ -1,0 +1,13 @@
+-- LF_I: refresh-insert inventory from the inventory staging table
+-- (role of reference nds/data_maintenance/LF_I.sql, original SQL).
+CREATE TEMP VIEW iv AS
+SELECT d_date_sk AS inv_date_sk,
+       i_item_sk AS inv_item_sk,
+       w_warehouse_sk AS inv_warehouse_sk,
+       invn_qty_on_hand AS inv_quantity_on_hand
+FROM s_inventory
+JOIN warehouse ON w_warehouse_id = invn_warehouse_id
+JOIN item ON i_item_id = invn_item_id
+JOIN date_dim ON d_date = CAST(invn_date AS DATE);
+INSERT INTO inventory SELECT * FROM iv;
+DROP VIEW iv

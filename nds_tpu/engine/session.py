@@ -24,6 +24,7 @@ from . import arrow_bridge
 class Session:
     def __init__(self, config: Optional[EngineConfig] = None):
         self.config = config or EngineConfig()
+        self.warehouse = None  # attached via attach_warehouse for DML
         self._loaders: dict[str, Callable[[], Table]] = {}
         self._schemas: dict[str, tuple[list[str], list[str]]] = {}
         self._est_rows: dict[str, int] = {}
@@ -137,6 +138,99 @@ class Session:
 
     def sql_arrow(self, query: str) -> pa.Table:
         return arrow_bridge.to_arrow(self.sql(query))
+
+    # -- statements (DML/DDL for the maintenance test) -----------------------
+    def attach_warehouse(self, warehouse) -> None:
+        """Bind a Warehouse so INSERT/DELETE statements commit snapshots
+        (the reference runs these against Iceberg/Delta catalogs,
+        nds_maintenance.py:107-116)."""
+        self.warehouse = warehouse
+        warehouse.register_all(self)
+
+    def execute(self, sql_text: str, backend: Optional[str] = None):
+        """Execute one or more ';'-separated statements; returns the last
+        query's Table (or None for pure DML)."""
+        from ..sql import parse_statements
+        from ..sql.ast_nodes import CreateView, Delete, DropView, Insert, Query
+
+        result = None
+        for stmt in parse_statements(sql_text):
+            if isinstance(stmt, Query):
+                result = self._run_query_ast(stmt, backend)
+            elif isinstance(stmt, CreateView):
+                table = self._run_query_ast(stmt.query, backend)
+                self.register_view(stmt.name, table)
+            elif isinstance(stmt, DropView):
+                self.drop(stmt.name)
+            elif isinstance(stmt, Insert):
+                self._insert(stmt, backend)
+            elif isinstance(stmt, Delete):
+                self._delete(stmt, backend)
+            else:
+                raise TypeError(type(stmt).__name__)
+        return result
+
+    def _run_query_ast(self, ast, backend: Optional[str]):
+        planner = Planner(self._catalog())
+        plan = planner.plan_query(ast)
+        use_jax = (backend == "jax") if backend else self.config.use_jax
+        if use_jax:
+            from .jax_backend import JaxExecutor, to_host
+            jexec = JaxExecutor(self.load_table)
+            out = to_host(jexec.execute(plan))
+            self.last_fallbacks = list(jexec.fallback_nodes)
+            return out
+        return Executor(self.load_table).execute(plan)
+
+    def _insert(self, stmt, backend: Optional[str]) -> None:
+        if self.warehouse is None:
+            raise RuntimeError("INSERT requires an attached warehouse")
+        rows = self._run_query_ast(stmt.query, backend)
+        target_names, _ = self._schemas[stmt.table]
+        data = arrow_bridge.to_arrow(rows).rename_columns(target_names)
+        self.warehouse.table(stmt.table).insert(data)
+        self.warehouse.register_all(self)  # refresh snapshot binding
+
+    def _delete(self, stmt, backend: Optional[str]) -> None:
+        """DELETE FROM <table> WHERE <pred>: rewrite warehouse files keeping
+        rows that do NOT satisfy the predicate (NULL predicate => kept,
+        standard SQL DELETE semantics). Subqueries in the predicate see the
+        session's other registered tables."""
+        if self.warehouse is None:
+            raise RuntimeError("DELETE requires an attached warehouse")
+        import numpy as np
+
+        from ..sql import parse_sql
+
+        wt = self.warehouse.table(stmt.table)
+        if stmt.where is None:
+            wt.delete_where(lambda t: pa.array([False] * t.num_rows))
+            self.warehouse.register_all(self)
+            return
+
+        def keep_filter(t: pa.Table):
+            # per-file scoped session: the target table IS this file's rows,
+            # extended with a rowid so the engine tells us which rows matched
+            tmp = Session(self.config)
+            for other in self._schemas:
+                if other == stmt.table:
+                    continue
+                tmp._schemas[other] = self._schemas[other]
+                tmp._loaders[other] = self._loaders[other]
+                tmp._est_rows[other] = self._est_rows.get(other, 1000)
+            with_id = t.append_column(
+                "__rowid", pa.array(np.arange(t.num_rows, dtype=np.int64)))
+            tmp.register_arrow(stmt.table, with_id)
+            q = parse_sql(f"SELECT __rowid FROM {stmt.table}")
+            q.body.where = stmt.where
+            hit = tmp._run_query_ast(q, backend="numpy")
+            deleted = np.zeros(t.num_rows, dtype=bool)
+            ids = np.asarray(hit.columns[0].data, dtype=np.int64)
+            deleted[ids[hit.columns[0].validity]] = True
+            return pa.array(~deleted)
+
+        wt.delete_where(keep_filter)
+        self.warehouse.register_all(self)
 
     def explain(self, query: str) -> str:
         ast = parse_sql(query)

@@ -1,0 +1,149 @@
+"""Data Maintenance test: run the LF_*/DF_* refresh functions, timed.
+
+Capability parity with the reference maintenance runner (reference
+nds/nds_maintenance.py): the function lists (:45-58), delete-date tuples
+read from the ``delete``/``inventory_delete`` tables (get_delete_date
+:60-73), ordered DATE1/DATE2 substitution producing one statement set per
+tuple (replace_date :75-96 — 3 tuples => 3x each delete), staging CSVs
+registered as temp views (register_temp_views :267-271), and per-function
+timing + CSV/JSON reporting identical in shape to the power run
+(run_query :204-265).
+"""
+from __future__ import annotations
+
+import argparse
+import csv
+import os
+import sys
+import time
+
+from .config import EngineConfig
+from .engine import Session
+from .report import BenchReport
+from .schema import get_maintenance_schemas
+from .warehouse import Warehouse
+
+SQL_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "data_maintenance")
+
+INSERT_FUNCS = ["LF_CR", "LF_CS", "LF_I", "LF_SR", "LF_SS", "LF_WR", "LF_WS"]
+DELETE_FUNCS = ["DF_CS", "DF_SS", "DF_WS"]
+INVENTORY_DELETE_FUNCS = ["DF_I"]
+MAINTENANCE_FUNCS = INSERT_FUNCS + DELETE_FUNCS + INVENTORY_DELETE_FUNCS
+
+
+def get_delete_date(refresh_dir: str) -> tuple[list, list]:
+    """Read DATE1/DATE2 tuples from the delete-date staging files."""
+    import pyarrow.csv as pa_csv
+
+    def read_pairs(table):
+        path = os.path.join(refresh_dir, table)
+        files = ([os.path.join(path, f) for f in sorted(os.listdir(path))]
+                 if os.path.isdir(path) else [path])
+        pairs = []
+        for f in files:
+            t = pa_csv.read_csv(
+                f, read_options=pa_csv.ReadOptions(
+                    column_names=["date1", "date2"]),
+                parse_options=pa_csv.ParseOptions(delimiter="|"))
+            pairs += list(zip(t.column("date1").to_pylist(),
+                              t.column("date2").to_pylist()))
+        return pairs
+
+    return read_pairs("delete"), read_pairs("inventory_delete")
+
+
+def replace_date(statements: str, pair: tuple[str, str]) -> str:
+    """Substitute the ordered DATE1/DATE2 pair (reference :75-96)."""
+    d1, d2 = sorted(pair)
+    return statements.replace("DATE1", d1).replace("DATE2", d2)
+
+
+def load_function_sql(func: str) -> str:
+    with open(os.path.join(SQL_DIR, f"{func}.sql")) as f:
+        # strip comment lines; the engine parser takes statement text
+        lines = [ln for ln in f.read().splitlines()
+                 if not ln.strip().startswith("--")]
+    return "\n".join(lines)
+
+
+def register_staging(session: Session, refresh_dir: str) -> None:
+    for name, sch in get_maintenance_schemas().items():
+        if name in ("delete", "inventory_delete"):
+            continue
+        path = os.path.join(refresh_dir, name)
+        if os.path.exists(path):
+            session.register_csv(name, path,
+                                 sch.arrow_schema(use_decimal=False))
+
+
+def run_maintenance(warehouse_path: str, refresh_dir: str, time_log: str,
+                    maintenance_queries: list[str] | None = None,
+                    json_summary_folder: str | None = None,
+                    backend: str | None = None
+                    ) -> list[tuple[str, int, int, int]]:
+    config = EngineConfig()
+    session = Session(config)
+    wh = Warehouse(warehouse_path)
+    session.attach_warehouse(wh)
+    register_staging(session, refresh_dir)
+    delete_dates, inventory_dates = get_delete_date(refresh_dir)
+
+    funcs = maintenance_queries or MAINTENANCE_FUNCS
+    rows = []
+    test_start = int(time.time() * 1000)
+    for func in funcs:
+        sql = load_function_sql(func)
+        if func in DELETE_FUNCS:
+            variants = [replace_date(sql, p) for p in delete_dates]
+        elif func in INVENTORY_DELETE_FUNCS:
+            variants = [replace_date(sql, p) for p in inventory_dates]
+        else:
+            variants = [sql]
+        report = BenchReport(config, app_name=f"NDS-TPU maintenance {func}")
+        start = int(time.time() * 1000)
+
+        def run_all(variants=variants):
+            for v in variants:
+                session.execute(v, backend=backend)
+        report.report_on(run_all)
+        elapsed = report.summary["queryTimes"][-1]
+        status = report.summary["queryStatus"][-1]
+        rows.append((func, start, start + elapsed, elapsed))
+        print(f"{func}: {status} in {elapsed} ms", flush=True)
+        if json_summary_folder:
+            report.write_summary(
+                func, prefix=os.path.join(json_summary_folder, "maintenance"))
+    test_end = int(time.time() * 1000)
+
+    os.makedirs(os.path.dirname(time_log) or ".", exist_ok=True)
+    with open(time_log, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["query", "start_time", "end_time", "time"])
+        w.writerow(["Maintenance Start Time", test_start, "", ""])
+        for r in rows:
+            w.writerow(r)
+        w.writerow(["Maintenance End Time", test_end, "", ""])
+        w.writerow(["Maintenance Test Time", "", "", test_end - test_start])
+    return rows
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="nds_tpu.maintenance")
+    p.add_argument("warehouse_path")
+    p.add_argument("refresh_dir", help="raw refresh (update-set) data dir")
+    p.add_argument("time_log")
+    p.add_argument("--maintenance_queries", default=None,
+                   help="comma-separated subset of LF_*/DF_* functions")
+    p.add_argument("--json_summary_folder", default=None)
+    p.add_argument("--backend", default=None, choices=["jax", "numpy"])
+    a = p.parse_args(argv)
+    funcs = (a.maintenance_queries.split(",") if a.maintenance_queries
+             else None)
+    run_maintenance(a.warehouse_path, a.refresh_dir, a.time_log, funcs,
+                    a.json_summary_folder, a.backend)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

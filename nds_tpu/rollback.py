@@ -1,0 +1,45 @@
+"""Warehouse rollback: time-travel fact tables to a pre-maintenance state.
+
+Capability parity with the reference rollback tool (reference
+nds/nds_rollback.py:36-55: Iceberg ``rollback_to_timestamp`` over the fact
+tables the maintenance test modifies, so Throughput/Maintenance test pairs
+can re-run against identical data).
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .warehouse import Warehouse
+
+# fact tables touched by LF_*/DF_* (reference :36-43 + DF_I's inventory)
+ROLLBACK_TABLES = [
+    "store_sales", "store_returns", "catalog_sales", "catalog_returns",
+    "web_sales", "web_returns", "inventory",
+]
+
+
+def rollback(warehouse_path: str, timestamp_ms: int,
+             tables: list[str] | None = None) -> None:
+    wh = Warehouse(warehouse_path)
+    for name in tables or ROLLBACK_TABLES:
+        wt = wh.table(name)
+        if wt.exists():
+            snap = wt.rollback_to_timestamp(timestamp_ms)
+            print(f"{name}: rolled back to snapshot state at <= "
+                  f"{timestamp_ms} (new version {snap['version']})")
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="nds_tpu.rollback")
+    p.add_argument("warehouse_path")
+    p.add_argument("timestamp_ms", type=int)
+    p.add_argument("--tables", default=None)
+    a = p.parse_args(argv)
+    rollback(a.warehouse_path, a.timestamp_ms,
+             a.tables.split(",") if a.tables else None)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,208 @@
+"""Parquet warehouse with snapshot manifests: insert/delete/time-travel.
+
+The capability subset of Iceberg/Delta that the benchmark actually uses
+(SURVEY.md §5 checkpoint/resume): ACID-ish table snapshots for the
+maintenance test's INSERT/DELETE refresh functions (reference
+nds/nds_maintenance.py) and timestamp rollback (reference
+nds/nds_rollback.py:36-55 calls Iceberg's rollback_to_timestamp over the 6
+fact tables maintenance touches).
+
+Layout per table:
+    <root>/<table>/manifest.json         (snapshot list, newest last)
+    <root>/<table>/data/part-*.parquet   (immutable data files)
+    <root>/<table>/data/<part_col>=<v>/part-*.parquet  (partitioned tables)
+
+A snapshot is {"version", "timestamp_ms", "files": [...]} — files are
+relative paths. Writers never mutate data files; insert appends files,
+delete rewrites affected files into new ones. Readers pin a snapshot.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import time
+import uuid
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+# fact-table partition keys (reference nds_transcode.py:45-53)
+TABLE_PARTITIONING = {
+    "catalog_sales": "cs_sold_date_sk",
+    "catalog_returns": "cr_returned_date_sk",
+    "inventory": "inv_date_sk",
+    "store_sales": "ss_sold_date_sk",
+    "store_returns": "sr_returned_date_sk",
+    "web_sales": "ws_sold_date_sk",
+    "web_returns": "wr_returned_date_sk",
+}
+
+
+class WarehouseTable:
+    def __init__(self, root: str, name: str):
+        self.dir = os.path.join(root, name)
+        self.name = name
+        self.manifest_path = os.path.join(self.dir, "manifest.json")
+
+    # -- manifest ------------------------------------------------------------
+    def _load(self) -> list[dict]:
+        if not os.path.exists(self.manifest_path):
+            return []
+        with open(self.manifest_path) as f:
+            return json.load(f)["snapshots"]
+
+    def _store(self, snapshots: list[dict]) -> None:
+        tmp = self.manifest_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"table": self.name, "snapshots": snapshots}, f,
+                      indent=1)
+        os.replace(tmp, self.manifest_path)
+
+    def _commit(self, files: list[str]) -> dict:
+        snapshots = self._load()
+        snap = {"version": len(snapshots) + 1,
+                "timestamp_ms": int(time.time() * 1000),
+                "files": sorted(files)}
+        snapshots.append(snap)
+        self._store(snapshots)
+        return snap
+
+    def exists(self) -> bool:
+        return os.path.exists(self.manifest_path)
+
+    def current_files(self) -> list[str]:
+        snaps = self._load()
+        if not snaps:
+            return []
+        return [os.path.join(self.dir, f) for f in snaps[-1]["files"]]
+
+    # -- writes --------------------------------------------------------------
+    def _write_file(self, table: pa.Table, partition_val=None) -> str:
+        base = f"part-{uuid.uuid4().hex[:12]}.parquet"
+        if partition_val is not None:
+            part_col = TABLE_PARTITIONING[self.name]
+            sub = f"{part_col}={partition_val}"
+            os.makedirs(os.path.join(self.dir, "data", sub), exist_ok=True)
+            rel = os.path.join("data", sub, base)
+        else:
+            os.makedirs(os.path.join(self.dir, "data"), exist_ok=True)
+            rel = os.path.join("data", base)
+        pq.write_table(table, os.path.join(self.dir, rel))
+        return rel
+
+    def _partitioned_files(self, table: pa.Table) -> list[str]:
+        """Write one file per partition value (partition column KEPT in the
+        file so explicit-file reads need no hive discovery)."""
+        part_col = TABLE_PARTITIONING.get(self.name)
+        if part_col is None or part_col not in table.column_names:
+            return [self._write_file(table)]
+        import pyarrow.compute as pc
+        col = table.column(part_col)
+        uniq = pc.unique(col)
+        files = []
+        for v in uniq.to_pylist():
+            if v is None:
+                mask = pc.is_null(col)
+                sub = table.filter(mask)
+                files.append(self._write_file(sub, "null"))
+            else:
+                mask = pc.equal(col, v)
+                sub = table.filter(pc.fill_null(mask, False))
+                files.append(self._write_file(sub, v))
+        return files
+
+    def create(self, table: pa.Table, partition: bool = True) -> dict:
+        os.makedirs(self.dir, exist_ok=True)
+        files = (self._partitioned_files(table) if partition
+                 else [self._write_file(table)])
+        return self._commit(files)
+
+    def insert(self, table: pa.Table, partition: bool = True) -> dict:
+        """Append rows as new files (Iceberg-style append snapshot)."""
+        old = self._load()[-1]["files"] if self._load() else []
+        files = (self._partitioned_files(table) if partition
+                 else [self._write_file(table)])
+        return self._commit(old + files)
+
+    def delete_where(self, keep_filter) -> dict:
+        """Rewrite files keeping rows where keep_filter(table) is True.
+
+        keep_filter: callable(pa.Table) -> pa.BooleanArray of rows to KEEP.
+        Files with nothing deleted are reused untouched.
+        """
+        new_files = []
+        for path in self.current_files():
+            t = pq.read_table(path)
+            keep = keep_filter(t)
+            import pyarrow.compute as pc
+            n_keep = pc.sum(pc.cast(keep, pa.int64())).as_py() or 0
+            rel = os.path.relpath(path, self.dir)
+            if n_keep == t.num_rows:
+                new_files.append(rel)
+                continue
+            if n_keep == 0:
+                continue
+            kept = t.filter(keep)
+            base = f"part-{uuid.uuid4().hex[:12]}.parquet"
+            new_rel = os.path.join(os.path.dirname(rel), base)
+            pq.write_table(kept, os.path.join(self.dir, new_rel))
+            new_files.append(new_rel)
+        return self._commit(new_files)
+
+    # -- time travel ---------------------------------------------------------
+    def rollback_to_timestamp(self, ts_ms: int) -> dict:
+        """New snapshot restoring the latest state at or before ts_ms
+        (reference nds_rollback.py rolls the 6 maintenance-touched fact
+        tables back to the pre-maintenance timestamp)."""
+        snaps = self._load()
+        target = None
+        for s in snaps:
+            if s["timestamp_ms"] <= ts_ms:
+                target = s
+        if target is None:
+            raise ValueError(f"no snapshot at or before {ts_ms}")
+        return self._commit(list(target["files"]))
+
+    def read(self) -> pa.Table:
+        files = self.current_files()
+        if not files:
+            raise FileNotFoundError(f"table {self.name} has no snapshot")
+        return pa.concat_tables([pq.read_table(f) for f in files],
+                                promote_options="permissive")
+
+
+class Warehouse:
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def table(self, name: str) -> WarehouseTable:
+        return WarehouseTable(self.root, name)
+
+    def table_names(self) -> list[str]:
+        return sorted(
+            os.path.basename(os.path.dirname(m)) for m in
+            glob.glob(os.path.join(self.root, "*", "manifest.json")))
+
+    def register_all(self, session, est_rows: dict[str, int] | None = None):
+        """Register every warehouse table on an engine Session."""
+        import pyarrow.dataset as pa_dataset
+
+        from .engine import arrow_bridge
+
+        for name in self.table_names():
+            wt = self.table(name)
+            files = wt.current_files()
+            if not files:
+                continue
+            dataset = pa_dataset.dataset(files, format="parquet")
+            names, dtypes = arrow_bridge.engine_schema(dataset.schema)
+            session._schemas[name] = (names, dtypes)
+            session._est_rows[name] = (est_rows or {}).get(
+                name, dataset.count_rows())
+
+            def load(ds=dataset):
+                return arrow_bridge.from_arrow(ds.to_table())
+            session._loaders[name] = load
+            session._cache.pop(name, None)

@@ -572,6 +572,7 @@ static void generic_value(const TableDef& t, int ci, int64_t row,
     if (!strcmp(n, "s_store_name") || !strcmp(n, "w_warehouse_name")) {
         L.s(POOL(r, SYLLABLES)); return;
     }
+    if (ends_with(n, "_company_name")) { L.s(POOL(r, SYLLABLES)); return; }
     if (ends_with(n, "_name") && c.length <= 60) {
         std::string v = POOL(r, WORDS); v += POOL(mix64(r), WORDS);
         L.s(v.substr(0, c.length ? c.length : 50)); return;

@@ -353,6 +353,26 @@ def _substr(expr: BCall, table: Table, sq) -> Column:
     return Column.from_values("str", out, a.valid, uniq.astype(object))
 
 
+def _case_map_str(a: Column, fn) -> Column:
+    """Apply a python string transform over the dictionary only."""
+    d = a.dictionary if a.dictionary is not None else np.empty(0, dtype=object)
+    newd = np.asarray([fn(v) for v in d.astype(str)], dtype=object)
+    uniq, remap = np.unique(newd.astype(str), return_inverse=True)
+    codes = np.asarray(a.data)
+    safe = np.where(codes >= 0, codes, 0)
+    out = np.where(codes >= 0,
+                   remap[safe] if len(remap) else 0, _NULL_CODE).astype(np.int32)
+    return Column.from_values("str", out, a.valid, uniq.astype(object))
+
+
+def _upper(expr: BCall, table: Table, sq) -> Column:
+    return _case_map_str(evaluate(expr.args[0], table, sq), str.upper)
+
+
+def _lower(expr: BCall, table: Table, sq) -> Column:
+    return _case_map_str(evaluate(expr.args[0], table, sq), str.lower)
+
+
 def _concat(expr: BCall, table: Table, sq) -> Column:
     cols = _eval_args(expr, table, sq)
     parts = []
@@ -417,5 +437,6 @@ _HANDLERS = {
     "in_list": _in_list, "like": _like,
     "case": _case, "coalesce": _coalesce, "cast": _cast,
     "substr": _substr, "concat": _concat, "abs": _abs, "round": _round,
+    "upper": _upper, "lower": _lower,
     "nullif": _nullif, "grouping_bit": _grouping_bit,
 }

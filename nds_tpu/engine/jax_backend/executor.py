@@ -224,8 +224,9 @@ class JaxExecutor:
             [rank_key(c) for c in active], [c.valid for c in active],
             child.alive)
         num_groups = int(num_groups_t)
-        if not node.group_exprs:
-            # a global aggregate over empty input still yields one row
+        if not active:
+            # a global aggregate (incl. a rollup's grand-total grouping set)
+            # over empty input still yields one row
             num_groups = max(num_groups, 1)
         alive_for_agg = child.alive
         cap_out = bucket(max(num_groups, 1))

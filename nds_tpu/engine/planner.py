@@ -284,7 +284,12 @@ class Planner:
                 return bound
             if it.expr == expr:
                 return bound
-        return binder.bind(expr)
+        try:
+            return binder.bind(expr)
+        except PlanError:
+            # aliases nested inside the sort expression (q36's
+            # `CASE WHEN lochierarchy = 0 THEN i_category END`)
+            return binder.bind(_substitute_aliases(expr, items))
 
     # -- FROM/WHERE join graph ----------------------------------------------
     def _plan_from_where(self, sel: A.Select, outer, ctes):
@@ -536,11 +541,20 @@ class Planner:
             neg2 = neg ^ node.negated
             return self._semi_anti(rel, scope, node.query, node.expr, neg2, ctes)
 
+        # EXISTS/IN nested below the conjunct level (e.g. q10/q35's
+        # `EXISTS(...) OR EXISTS(...)`, q45's `zip IN (...) OR id IN (subq)`):
+        # mark joins — each subquery left-joins a distinct key set and is
+        # replaced by an IS NOT NULL test on the joined mark column
+        marks: dict[int, P.BExpr] = {}
+        for sub in _nested_subqueries(node):
+            rel, mark = self._mark_join(rel, scope, sub, ctes)
+            marks[id(sub)] = mark
+
         # comparison containing scalar subqueries
         rel2, scope2, rewritten = self._decorrelate_scalars(rel, scope, node,
                                                             ctes)
         binder2 = _Binder(self, scope2, ctes, outer=outer,
-                          subquery_cols=rewritten)
+                          subquery_cols={**rewritten, **marks})
         pred = binder2.bind(node)
         if neg:
             pred = P.BCall("bool", "not", [pred])
@@ -554,10 +568,56 @@ class Planner:
                                  out_dtypes=list(rel2.out_dtypes[:width]))
         return filtered
 
+    def _mark_join(self, rel, scope, sub, ctes):
+        """Mark join: left-join a distinct correlated key set and return the
+        widened relation plus a boolean expression that is TRUE iff the
+        subquery matched (two-valued logic; NOT IN null semantics are only
+        guaranteed in the conjunct-level path)."""
+        in_expr = sub.expr if isinstance(sub, A.InSubquery) else None
+        negated = getattr(sub, "negated", False)
+        sub_plan, corr_pairs, inner_keys, mixed, _inner_scope = \
+            self._plan_correlated(sub.query, scope, ctes)
+        if mixed:
+            raise PlanError("non-equality correlation in a nested subquery "
+                            "is unsupported")
+        outer_binder = _Binder(self, scope, ctes, outer=scope.parent)
+        lkeys = [outer_binder.bind(oe) for oe, _ in corr_pairs]
+        rkeys = list(inner_keys)
+        if in_expr is not None:
+            lkeys.append(outer_binder.bind(in_expr))
+            rkeys.append(P.BCol(sub_plan.out_dtypes[0], 0,
+                                sub_plan.out_names[0]))
+        if not lkeys:
+            raise PlanError("uncorrelated EXISTS in a nested position "
+                            "is unsupported")
+        key_exprs = [P.BCol(k.dtype, k.index, f"mk{i}")
+                     for i, k in enumerate(rkeys)]
+        names = [f"mk{i}" for i in range(len(key_exprs))]
+        dtypes = [k.dtype for k in rkeys]
+        proj = P.ProjectNode(sub_plan, key_exprs, out_names=names,
+                             out_dtypes=dtypes)
+        dist = P.DistinctNode(proj, out_names=names, out_dtypes=dtypes)
+        new_rkeys = [P.BCol(d, i, names[i]) for i, d in enumerate(dtypes)]
+        nleft = len(rel.out_names)
+        joined = P.JoinNode(rel, dist, "left", lkeys, new_rkeys, None,
+                            out_names=list(rel.out_names) + names,
+                            out_dtypes=list(rel.out_dtypes) + dtypes)
+        mark = P.BCall("bool", "isnotnull",
+                       [P.BCol(dtypes[0], nleft, names[0])])
+        if negated:
+            mark = P.BCall("bool", "not", [mark])
+        return joined, mark
+
     def _semi_anti(self, rel, scope, subq: A.Query, in_expr, negated, ctes):
-        """EXISTS/IN subqueries as semi/anti joins with correlation keys."""
-        sub_plan, corr_pairs, inner_keys = self._plan_correlated(subq, scope,
-                                                                 ctes)
+        """EXISTS/IN subqueries as semi/anti joins with correlation keys.
+
+        Mixed outer/inner conjuncts that aren't equality correlations (e.g.
+        q16's cs1.cs_warehouse_sk <> cs2.cs_warehouse_sk) become a residual
+        predicate evaluated over matched [outer row | subquery row] pairs
+        before the semi/anti reduction (ops.join residual_eval contract).
+        """
+        sub_plan, corr_pairs, inner_keys, mixed, inner_scope = \
+            self._plan_correlated(subq, scope, ctes)
         outer_binder = _Binder(self, scope, ctes, outer=scope.parent)
         lkeys = [outer_binder.bind(oe) for oe, _ in corr_pairs]
         rkeys = list(inner_keys)
@@ -567,10 +627,27 @@ class Planner:
                                 sub_plan.out_names[0]))
         if not lkeys:
             raise PlanError("EXISTS subquery without correlation is unsupported")
+        residual = None
+        if mixed:
+            # combined schema = outer columns, then sub_plan columns; inner
+            # entries shadow outer ones (innermost scope wins for unqualified
+            # names), with indices offset past the outer width
+            nleft = len(rel.out_names)
+            ncore = len(sub_plan.out_names) - len(inner_scope.entries)
+            entries = [ScopeEntry(e.qualifier, e.name, e.dtype,
+                                  nleft + ncore + i)
+                       for i, e in enumerate(inner_scope.entries)]
+            entries += list(scope.entries)
+            combined = Scope(entries, parent=scope.parent)
+            rbinder = _Binder(self, combined, ctes, outer=scope.parent)
+            for c in mixed:
+                pred = rbinder.bind(c)
+                residual = pred if residual is None else \
+                    P.BCall("bool", "and", [residual, pred])
         kind = "anti" if negated else "semi"
         # NOT IN (subquery) needs SQL null semantics; NOT EXISTS does not
         null_aware = negated and in_expr is not None
-        return P.JoinNode(rel, sub_plan, kind, lkeys, rkeys, None,
+        return P.JoinNode(rel, sub_plan, kind, lkeys, rkeys, residual,
                           null_aware=null_aware,
                           out_names=list(rel.out_names),
                           out_dtypes=list(rel.out_dtypes))
@@ -627,8 +704,9 @@ class Planner:
         body = subq.body
         if not isinstance(body, A.Select):
             raise PlanError("unsupported subquery form")
-        corr, inner_where = _extract_correlation(body.where, outer_scope, self,
-                                                 ctes, body)
+        corr, mixed, inner_where = _extract_correlation(body.where,
+                                                        outer_scope, self,
+                                                        ctes, body)
         inner_sel = replace(body, where=inner_where)
         rel, inner_scope, _ = self._plan_from_where(inner_sel, None, ctes)
         binder = _Binder(self, inner_scope, ctes, outer=None)
@@ -640,12 +718,17 @@ class Planner:
                 sel_exprs.append(binder.bind(it.expr))
         extra_exprs = [binder.bind(ie) for _, ie in corr]
         all_exprs = sel_exprs + extra_exprs
+        if mixed:
+            # expose every inner column so the caller can bind the residual
+            # over the combined [outer | subquery] schema
+            all_exprs = all_exprs + [
+                P.BCol(e.dtype, e.index, e.name) for e in inner_scope.entries]
         plan = P.ProjectNode(rel, all_exprs,
                              out_names=[f"c{i}" for i in range(len(all_exprs))],
                              out_dtypes=[e.dtype for e in all_exprs])
         inner_keys = [P.BCol(e.dtype, len(sel_exprs) + i, f"k{i}")
                       for i, e in enumerate(extra_exprs)]
-        return plan, corr, inner_keys
+        return plan, corr, inner_keys, mixed, inner_scope
 
     def _plan_scalar_agg_subquery(self, subq: A.Query, outer_scope, ctes):
         """Decorrelate `(select AGG-expr from ... where corr-eqs and filters)`.
@@ -660,8 +743,12 @@ class Planner:
         body = subq.body
         if not isinstance(body, A.Select) or len(body.items) != 1:
             raise PlanError("unsupported correlated scalar subquery")
-        corr, inner_where = _extract_correlation(body.where, outer_scope, self,
-                                                 ctes, body)
+        corr, mixed, inner_where = _extract_correlation(body.where,
+                                                        outer_scope, self,
+                                                        ctes, body)
+        if mixed:
+            raise PlanError("non-equality correlation in scalar subquery "
+                            "is unsupported")
         if not corr:
             raise PlanError("scalar subquery marked correlated but no equality "
                             "correlation found")
@@ -797,7 +884,8 @@ class Planner:
             entries.append(ScopeEntry(None, f.name, f.dtype, base + i))
         new_scope = Scope(entries, parent=outer)
         new_binder = _Binder(self, new_scope, ctes, outer=outer,
-                             rewrites=rewrites)
+                             rewrites=rewrites,
+                             num_group_cols=binder.num_group_cols)
         return node, new_scope, new_binder
 
 
@@ -930,7 +1018,7 @@ class _Binder:
         e = self.bind(node.expr)
         values = []
         for item in node.items:
-            b = self.bind(item)
+            b = _const_fold(self.bind(item))
             if not isinstance(b, P.BLit):
                 raise PlanError("IN list values must be literals")
             v = b.value
@@ -1031,6 +1119,8 @@ class _Binder:
             return P.BCall("int", "grouping_bit", [gid_col], extra=bit)
         if name == "concat":
             return P.BCall("str", "concat", args)
+        if name in ("upper", "lower"):
+            return P.BCall("str", name, args)
         raise PlanError(f"unsupported function {name}")
 
     def _bind_scalarsubquery(self, node: A.ScalarSubquery) -> P.BExpr:
@@ -1042,9 +1132,13 @@ class _Binder:
         return P.BScalarSubquery(plan.out_dtypes[0], plan)
 
     def _bind_exists(self, node: A.Exists):
+        if id(node) in self.subquery_cols:
+            return self.subquery_cols[id(node)]
         raise PlanError("EXISTS is only supported as a WHERE conjunct")
 
     def _bind_insubquery(self, node: A.InSubquery):
+        if id(node) in self.subquery_cols:
+            return self.subquery_cols[id(node)]
         raise PlanError("IN <subquery> is only supported as a WHERE conjunct")
 
     def _bind_star(self, node: A.Star):
@@ -1238,7 +1332,7 @@ def _extract_correlation(where, outer_scope, planner, ctes, inner_sel):
     Returns ([(outer_ast, inner_ast)], remaining_where_ast).
     """
     if where is None:
-        return [], None
+        return [], [], None
     inner_quals = _relation_aliases(inner_sel)
     inner_cols = _inner_columns(inner_sel, planner, ctes)
 
@@ -1274,6 +1368,7 @@ def _extract_correlation(where, outer_scope, planner, ctes, inner_sel):
         return None
 
     corr = []
+    mixed = []
     rest = []
     for c in _split_and(where):
         if isinstance(c, A.BinOp) and c.op == "=":
@@ -1284,11 +1379,94 @@ def _extract_correlation(where, outer_scope, planner, ctes, inner_sel):
             if ls is False and rs is True:
                 corr.append((c.right, c.left))
                 continue
-        rest.append(c)
+        # non-extractable conjuncts that still reference the outer scope
+        # (e.g. q16's cs1.cs_warehouse_sk <> cs2.cs_warehouse_sk) become
+        # residual predicates on the semi/anti join
+        if side_is_outer(c) in (True, None):
+            mixed.append(c)
+        else:
+            rest.append(c)
     remaining = None
     for c in rest:
         remaining = c if remaining is None else A.BinOp("and", remaining, c)
-    return corr, remaining
+    return corr, mixed, remaining
+
+
+def _substitute_aliases(expr, items):
+    """Rewrite bare ColumnRefs naming a select alias into the aliased
+    expression (for ORDER BY expressions referencing output aliases)."""
+    import dataclasses
+
+    aliases = {it.alias: it.expr for it in items if it.alias}
+
+    def walk(x):
+        if isinstance(x, A.ColumnRef) and x.qualifier is None and \
+                x.name in aliases:
+            return aliases[x.name]
+        if dataclasses.is_dataclass(x) and not isinstance(x, type):
+            changes = {}
+            for f in dataclasses.fields(x):
+                v = getattr(x, f.name)
+                if isinstance(v, tuple):
+                    nv = tuple(walk(e) if dataclasses.is_dataclass(e) else e
+                               for e in v)
+                    if nv != v:
+                        changes[f.name] = nv
+                elif isinstance(v, list):
+                    nv = [walk(e) if dataclasses.is_dataclass(e) else
+                          (tuple(walk(s) if dataclasses.is_dataclass(s) else s
+                                 for s in e) if isinstance(e, tuple) else e)
+                          for e in v]
+                    if nv != v:
+                        changes[f.name] = nv
+                elif dataclasses.is_dataclass(v) and not isinstance(v, type):
+                    nv = walk(v)
+                    if nv is not v:
+                        changes[f.name] = nv
+            return dataclasses.replace(x, **changes) if changes else x
+        return x
+
+    return walk(expr)
+
+
+def _nested_subqueries(node) -> list:
+    """Exists/InSubquery nodes anywhere in `node` (the conjunct itself is
+    never returned — callers handle the top level); does not descend into
+    subquery bodies."""
+    out = []
+
+    def visit(x, top):
+        if isinstance(x, (A.Exists, A.InSubquery)):
+            if not top:
+                out.append(x)
+            return
+        if isinstance(x, A.ScalarSubquery):
+            return
+        for ch in _children(x):
+            visit(ch, False)
+    visit(node, True)
+    return out
+
+
+def _const_fold(e: P.BExpr) -> P.BExpr:
+    """Fold arithmetic over literals (e.g. the IN-list element [YEAR] + 1
+    instantiated as 1999 + 1) into a single literal."""
+    if not isinstance(e, P.BCall):
+        return e
+    ops = {"add": lambda a, b: a + b, "sub": lambda a, b: a - b,
+           "mul": lambda a, b: a * b, "neg": lambda a: -a}
+    if e.op == "div":
+        ops["div"] = lambda a, b: a / b
+    fn = ops.get(e.op)
+    if fn is None:
+        return e
+    args = [_const_fold(a) for a in e.args]
+    if all(isinstance(a, P.BLit) and a.value is not None for a in args):
+        try:
+            return P.BLit(e.dtype, fn(*[a.value for a in args]))
+        except (TypeError, ZeroDivisionError):
+            return e
+    return e
 
 
 # -- dtype coercion ----------------------------------------------------------

@@ -55,7 +55,7 @@ def get_delete_date(refresh_dir: str) -> tuple[list, list]:
 
 def replace_date(statements: str, pair: tuple[str, str]) -> str:
     """Substitute the ordered DATE1/DATE2 pair (reference :75-96)."""
-    d1, d2 = sorted(pair)
+    d1, d2 = sorted(str(d) for d in pair)
     return statements.replace("DATE1", d1).replace("DATE2", d2)
 
 

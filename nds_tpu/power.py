@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import argparse
 import csv
+import glob
 import os
 import re
 import sys
@@ -67,6 +68,15 @@ def setup_tables(session: Session, input_prefix: str, input_format: str,
     nds_power.py:94-104).
     """
     times: dict[str, float] = {}
+    if input_format == "parquet" and glob.glob(
+            os.path.join(input_prefix, "*", "manifest.json")):
+        # warehouse layout (snapshot manifests): register pinned snapshots,
+        # the reference's warehouse-catalog path (nds_power.py:107-121)
+        from .warehouse import Warehouse
+        t0 = time.perf_counter()
+        Warehouse(input_prefix).register_all(session)
+        times["warehouse"] = time.perf_counter() - t0
+        return times
     schemas = dict(get_schemas(use_decimal))
     if maintenance:
         schemas.update(get_maintenance_schemas(use_decimal))
@@ -150,7 +160,8 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
     if sub_queries:
         query_dict = OrderedDict(
             (k, v) for k, v in query_dict.items()
-            if k in sub_queries or k.rstrip("_part12") in sub_queries)
+            if k in sub_queries
+            or re.sub(r"_part[12]$", "", k) in sub_queries)
 
     rows: list[tuple[str, int, int, int]] = []
     power_start = int(time.time() * 1000)

@@ -70,6 +70,25 @@ def _eval_param(expr: str, r: int):
     m = re.match(r"^dist_month\(\)$", expr)
     if m:
         return str(1 + r % 12)
+    m = re.match(r"^ziplist\((\d+)\)$", expr)
+    if m:
+        # k distinct 5-digit zips, quoted + comma-joined (q8-style IN list)
+        k = int(m.group(1))
+        rr, seen = r, []
+        while len(seen) < k:
+            z = f"'{rr % 100000:05d}'"
+            if z not in seen:
+                seen.append(z)
+            rr = (rr * 6364136223846793005 + 1442695040888963407) % (1 << 64)
+        return ", ".join(seen)
+    m = re.match(r"^rand_date\((\d+),\s*(\d+)\)$", expr)
+    if m:
+        # uniform date within [y_lo, y_hi], day 1..28 (dsqgen date params)
+        y_lo, y_hi = int(m.group(1)), int(m.group(2))
+        y = y_lo + r % (y_hi - y_lo + 1)
+        mo = 1 + (r >> 8) % 12
+        d = 1 + (r >> 16) % 28
+        return f"{y:04d}-{mo:02d}-{d:02d}"
     raise ValueError(f"unsupported parameter expression: {expr!r}")
 
 

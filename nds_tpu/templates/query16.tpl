@@ -1,0 +1,23 @@
+-- define [DATE] = rand_date(1999, 2002)
+-- define [STATE] = choice('GA','ID','IL','IN','IA','KS','KY','LA')
+-- define [COUNTIES] = choice_n(5, 'Williamson County','Walker County','Ziebach County','Daviess County','Barrow County','Franklin Parish','Luce County','Richland County','Furnas County','Maverick County')
+SELECT COUNT(DISTINCT cs_order_number) AS order_count,
+       SUM(cs_ext_ship_cost) AS total_shipping_cost,
+       SUM(cs_net_profit) AS total_net_profit
+FROM catalog_sales cs1, date_dim, customer_address, call_center
+WHERE d_date BETWEEN CAST('[DATE]' AS DATE)
+                 AND (CAST('[DATE]' AS DATE) + INTERVAL 60 DAYS)
+  AND cs1.cs_ship_date_sk = d_date_sk
+  AND cs1.cs_ship_addr_sk = ca_address_sk
+  AND ca_state = '[STATE]'
+  AND cs1.cs_call_center_sk = cc_call_center_sk
+  AND cc_county IN ([COUNTIES])
+  AND EXISTS (SELECT *
+              FROM catalog_sales cs2
+              WHERE cs1.cs_order_number = cs2.cs_order_number
+                AND cs1.cs_warehouse_sk <> cs2.cs_warehouse_sk)
+  AND NOT EXISTS (SELECT *
+                  FROM catalog_returns cr1
+                  WHERE cs1.cs_order_number = cr1.cr_order_number)
+ORDER BY order_count
+LIMIT 100

@@ -1,0 +1,29 @@
+-- define [YEAR] = uniform_int(1998, 2002)
+-- define [GEN] = choice('M', 'F')
+-- define [ES] = choice('Primary','Secondary','College','2 yr Degree','4 yr Degree','Advanced Degree','Unknown')
+-- define [MONTHS] = choice_n(6, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12)
+-- define [STATES] = choice_n(7, 'AL','CA','CO','FL','GA','IA','IL','IN','KS','KY','LA','MI','MN','MO','MS','NC','ND')
+SELECT i_item_id, ca_country, ca_state, ca_county,
+       AVG(CAST(cs_quantity AS DOUBLE)) AS agg1,
+       AVG(CAST(cs_list_price AS DOUBLE)) AS agg2,
+       AVG(CAST(cs_coupon_amt AS DOUBLE)) AS agg3,
+       AVG(CAST(cs_sales_price AS DOUBLE)) AS agg4,
+       AVG(CAST(cs_net_profit AS DOUBLE)) AS agg5,
+       AVG(CAST(c_birth_year AS DOUBLE)) AS agg6,
+       AVG(CAST(cd1.cd_dep_count AS DOUBLE)) AS agg7
+FROM catalog_sales, customer_demographics cd1, customer_demographics cd2,
+     customer, customer_address, date_dim, item
+WHERE cs_sold_date_sk = d_date_sk
+  AND cs_item_sk = i_item_sk
+  AND cs_bill_cdemo_sk = cd1.cd_demo_sk
+  AND cs_bill_customer_sk = c_customer_sk
+  AND cd1.cd_gender = '[GEN]'
+  AND cd1.cd_education_status = '[ES]'
+  AND c_current_cdemo_sk = cd2.cd_demo_sk
+  AND c_current_addr_sk = ca_address_sk
+  AND c_birth_month IN ([MONTHS])
+  AND d_year = [YEAR]
+  AND ca_state IN ([STATES])
+GROUP BY ROLLUP (i_item_id, ca_country, ca_state, ca_county)
+ORDER BY ca_country, ca_state, ca_county, i_item_id
+LIMIT 100

@@ -1,0 +1,23 @@
+-- define [YEAR] = uniform_int(1998, 2002)
+-- define [GEN] = choice('M', 'F')
+-- define [MS] = choice('S','M','D','W','U')
+-- define [ES] = choice('Primary','Secondary','College','2 yr Degree','4 yr Degree','Advanced Degree','Unknown')
+-- define [STATES] = choice_n(6, 'AL','AK','AZ','CA','CO','FL','GA','IA','IL','IN','KS','KY','LA','MI','MN','MO')
+SELECT i_item_id, s_state, GROUPING(s_state) AS g_state,
+       AVG(ss_quantity) AS agg1,
+       AVG(ss_list_price) AS agg2,
+       AVG(ss_coupon_amt) AS agg3,
+       AVG(ss_sales_price) AS agg4
+FROM store_sales, customer_demographics, date_dim, store, item
+WHERE ss_sold_date_sk = d_date_sk
+  AND ss_item_sk = i_item_sk
+  AND ss_store_sk = s_store_sk
+  AND ss_cdemo_sk = cd_demo_sk
+  AND cd_gender = '[GEN]'
+  AND cd_marital_status = '[MS]'
+  AND cd_education_status = '[ES]'
+  AND d_year = [YEAR]
+  AND s_state IN ([STATES])
+GROUP BY ROLLUP (i_item_id, s_state)
+ORDER BY i_item_id, s_state
+LIMIT 100

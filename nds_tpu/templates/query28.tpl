@@ -1,0 +1,53 @@
+-- define [LP] = uniform_int(0, 190)
+-- define [CP] = uniform_int(0, 18000)
+-- define [WC] = uniform_int(0, 80)
+SELECT *
+FROM (SELECT AVG(ss_list_price) AS b1_lp,
+             COUNT(ss_list_price) AS b1_cnt,
+             COUNT(DISTINCT ss_list_price) AS b1_cntd
+      FROM store_sales
+      WHERE ss_quantity BETWEEN 0 AND 5
+        AND (ss_list_price BETWEEN [LP] AND [LP] + 10
+             OR ss_coupon_amt BETWEEN [CP] AND [CP] + 1000
+             OR ss_wholesale_cost BETWEEN [WC] AND [WC] + 20)) b1,
+     (SELECT AVG(ss_list_price) AS b2_lp,
+             COUNT(ss_list_price) AS b2_cnt,
+             COUNT(DISTINCT ss_list_price) AS b2_cntd
+      FROM store_sales
+      WHERE ss_quantity BETWEEN 6 AND 10
+        AND (ss_list_price BETWEEN [LP] + 10 AND [LP] + 20
+             OR ss_coupon_amt BETWEEN [CP] + 1000 AND [CP] + 2000
+             OR ss_wholesale_cost BETWEEN [WC] + 10 AND [WC] + 30)) b2,
+     (SELECT AVG(ss_list_price) AS b3_lp,
+             COUNT(ss_list_price) AS b3_cnt,
+             COUNT(DISTINCT ss_list_price) AS b3_cntd
+      FROM store_sales
+      WHERE ss_quantity BETWEEN 11 AND 15
+        AND (ss_list_price BETWEEN [LP] + 20 AND [LP] + 30
+             OR ss_coupon_amt BETWEEN [CP] + 2000 AND [CP] + 3000
+             OR ss_wholesale_cost BETWEEN [WC] + 20 AND [WC] + 40)) b3,
+     (SELECT AVG(ss_list_price) AS b4_lp,
+             COUNT(ss_list_price) AS b4_cnt,
+             COUNT(DISTINCT ss_list_price) AS b4_cntd
+      FROM store_sales
+      WHERE ss_quantity BETWEEN 16 AND 20
+        AND (ss_list_price BETWEEN [LP] + 30 AND [LP] + 40
+             OR ss_coupon_amt BETWEEN [CP] + 3000 AND [CP] + 4000
+             OR ss_wholesale_cost BETWEEN [WC] + 30 AND [WC] + 50)) b4,
+     (SELECT AVG(ss_list_price) AS b5_lp,
+             COUNT(ss_list_price) AS b5_cnt,
+             COUNT(DISTINCT ss_list_price) AS b5_cntd
+      FROM store_sales
+      WHERE ss_quantity BETWEEN 21 AND 25
+        AND (ss_list_price BETWEEN [LP] + 40 AND [LP] + 50
+             OR ss_coupon_amt BETWEEN [CP] + 4000 AND [CP] + 5000
+             OR ss_wholesale_cost BETWEEN [WC] + 40 AND [WC] + 60)) b5,
+     (SELECT AVG(ss_list_price) AS b6_lp,
+             COUNT(ss_list_price) AS b6_cnt,
+             COUNT(DISTINCT ss_list_price) AS b6_cntd
+      FROM store_sales
+      WHERE ss_quantity BETWEEN 26 AND 30
+        AND (ss_list_price BETWEEN [LP] + 50 AND [LP] + 60
+             OR ss_coupon_amt BETWEEN [CP] + 5000 AND [CP] + 6000
+             OR ss_wholesale_cost BETWEEN [WC] + 50 AND [WC] + 70)) b6
+LIMIT 100

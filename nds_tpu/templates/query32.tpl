@@ -1,0 +1,17 @@
+-- define [IMID] = uniform_int(1, 1000)
+-- define [SDATE] = rand_date(1998, 2002)
+SELECT SUM(cs_ext_discount_amt) AS excess_discount_amount
+FROM catalog_sales, item, date_dim
+WHERE i_manufact_id = [IMID]
+  AND i_item_sk = cs_item_sk
+  AND d_date BETWEEN CAST('[SDATE]' AS DATE)
+                 AND (CAST('[SDATE]' AS DATE) + INTERVAL 90 DAYS)
+  AND d_date_sk = cs_sold_date_sk
+  AND cs_ext_discount_amt >
+      (SELECT 1.3 * AVG(cs_ext_discount_amt)
+       FROM catalog_sales, date_dim
+       WHERE cs_item_sk = i_item_sk
+         AND d_date BETWEEN CAST('[SDATE]' AS DATE)
+                        AND (CAST('[SDATE]' AS DATE) + INTERVAL 90 DAYS)
+         AND d_date_sk = cs_sold_date_sk)
+LIMIT 100

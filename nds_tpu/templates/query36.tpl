@@ -1,0 +1,21 @@
+-- define [YEAR] = uniform_int(1998, 2002)
+-- define [STATES] = choice_n(8, 'AL','AK','AZ','CA','CO','FL','GA','IA','IL','IN','KS','KY','LA','MI','MN','MO','MS','NC')
+SELECT SUM(ss_net_profit) / SUM(ss_ext_sales_price) AS gross_margin,
+       i_category, i_class,
+       GROUPING(i_category) + GROUPING(i_class) AS lochierarchy,
+       RANK() OVER (PARTITION BY GROUPING(i_category) + GROUPING(i_class),
+                                 CASE WHEN GROUPING(i_class) = 0
+                                      THEN i_category END
+                    ORDER BY SUM(ss_net_profit) / SUM(ss_ext_sales_price)
+                        ASC) AS rank_within_parent
+FROM store_sales, date_dim d1, item, store
+WHERE d1.d_year = [YEAR]
+  AND d1.d_date_sk = ss_sold_date_sk
+  AND i_item_sk = ss_item_sk
+  AND s_store_sk = ss_store_sk
+  AND s_state IN ([STATES])
+GROUP BY ROLLUP (i_category, i_class)
+ORDER BY lochierarchy DESC,
+         CASE WHEN lochierarchy = 0 THEN i_category END,
+         rank_within_parent
+LIMIT 100

@@ -1,0 +1,28 @@
+-- define [DMS] = uniform_int(1176, 1224)
+SELECT *
+FROM (SELECT i_manager_id,
+             SUM(ss_sales_price) AS sum_sales,
+             AVG(SUM(ss_sales_price)) OVER (PARTITION BY i_manager_id)
+                 AS avg_monthly_sales
+      FROM item, store_sales, date_dim, store
+      WHERE ss_item_sk = i_item_sk
+        AND ss_sold_date_sk = d_date_sk
+        AND ss_store_sk = s_store_sk
+        AND d_month_seq IN ([DMS], [DMS] + 1, [DMS] + 2, [DMS] + 3,
+                            [DMS] + 4, [DMS] + 5, [DMS] + 6, [DMS] + 7,
+                            [DMS] + 8, [DMS] + 9, [DMS] + 10, [DMS] + 11)
+        AND ((i_category IN ('Books', 'Children', 'Electronics')
+              AND i_class IN ('personal', 'portable', 'reference', 'self-help')
+              AND i_brand IN ('corpbrand #1', 'corpbrand #4',
+                              'importbrand #9', 'corpbrand #9'))
+             OR (i_category IN ('Women', 'Music', 'Men')
+                 AND i_class IN ('accessories', 'classical',
+                                 'fragrances', 'pants')
+                 AND i_brand IN ('importbrand #1', 'corpbrand #2',
+                                 'importbrand #3', 'importbrand #7')))
+      GROUP BY i_manager_id, d_moy) tmp1
+WHERE CASE WHEN avg_monthly_sales > 0
+           THEN ABS(sum_sales - avg_monthly_sales) / avg_monthly_sales
+           ELSE NULL END > 0.1
+ORDER BY i_manager_id, avg_monthly_sales, sum_sales
+LIMIT 100

@@ -1,0 +1,16 @@
+-- define [PRICE] = uniform_int(10, 60)
+-- define [SDATE] = rand_date(1998, 2002)
+-- define [MANUFACTS] = choice_n(4, 129, 270, 821, 423, 129, 271, 917, 318, 561, 95, 742, 134, 606, 882, 283, 553, 651, 774, 818, 995)
+SELECT i_item_id, i_item_desc, i_current_price
+FROM item, inventory, date_dim, store_sales
+WHERE i_current_price BETWEEN [PRICE] AND [PRICE] + 30
+  AND inv_item_sk = i_item_sk
+  AND d_date_sk = inv_date_sk
+  AND d_date BETWEEN CAST('[SDATE]' AS DATE)
+                 AND (CAST('[SDATE]' AS DATE) + INTERVAL 60 DAYS)
+  AND i_manufact_id IN ([MANUFACTS])
+  AND inv_quantity_on_hand BETWEEN 100 AND 500
+  AND ss_item_sk = i_item_sk
+GROUP BY i_item_id, i_item_desc, i_current_price
+ORDER BY i_item_id
+LIMIT 100

@@ -1,0 +1,117 @@
+-- define [DEP1] = uniform_int(-1, 4)
+-- define [DEP2] = uniform_int(-1, 4)
+-- define [DEP3] = uniform_int(-1, 4)
+-- define [STORE] = choice('ought','able','pri','ese','anti','cally','ation','eing','n st','bar')
+SELECT *
+FROM (SELECT COUNT(*) AS h8_30_to_9
+      FROM store_sales, household_demographics, time_dim, store
+      WHERE ss_sold_time_sk = time_dim.t_time_sk
+        AND ss_hdemo_sk = household_demographics.hd_demo_sk
+        AND ss_store_sk = s_store_sk
+        AND time_dim.t_hour = 8
+        AND time_dim.t_minute >= 30
+        AND ((household_demographics.hd_dep_count = [DEP1]
+              AND household_demographics.hd_vehicle_count <= [DEP1] + 2)
+             OR (household_demographics.hd_dep_count = [DEP2]
+                 AND household_demographics.hd_vehicle_count <= [DEP2] + 2)
+             OR (household_demographics.hd_dep_count = [DEP3]
+                 AND household_demographics.hd_vehicle_count <= [DEP3] + 2))
+        AND store.s_store_name = '[STORE]') s1,
+     (SELECT COUNT(*) AS h9_to_9_30
+      FROM store_sales, household_demographics, time_dim, store
+      WHERE ss_sold_time_sk = time_dim.t_time_sk
+        AND ss_hdemo_sk = household_demographics.hd_demo_sk
+        AND ss_store_sk = s_store_sk
+        AND time_dim.t_hour = 9
+        AND time_dim.t_minute < 30
+        AND ((household_demographics.hd_dep_count = [DEP1]
+              AND household_demographics.hd_vehicle_count <= [DEP1] + 2)
+             OR (household_demographics.hd_dep_count = [DEP2]
+                 AND household_demographics.hd_vehicle_count <= [DEP2] + 2)
+             OR (household_demographics.hd_dep_count = [DEP3]
+                 AND household_demographics.hd_vehicle_count <= [DEP3] + 2))
+        AND store.s_store_name = '[STORE]') s2,
+     (SELECT COUNT(*) AS h9_30_to_10
+      FROM store_sales, household_demographics, time_dim, store
+      WHERE ss_sold_time_sk = time_dim.t_time_sk
+        AND ss_hdemo_sk = household_demographics.hd_demo_sk
+        AND ss_store_sk = s_store_sk
+        AND time_dim.t_hour = 9
+        AND time_dim.t_minute >= 30
+        AND ((household_demographics.hd_dep_count = [DEP1]
+              AND household_demographics.hd_vehicle_count <= [DEP1] + 2)
+             OR (household_demographics.hd_dep_count = [DEP2]
+                 AND household_demographics.hd_vehicle_count <= [DEP2] + 2)
+             OR (household_demographics.hd_dep_count = [DEP3]
+                 AND household_demographics.hd_vehicle_count <= [DEP3] + 2))
+        AND store.s_store_name = '[STORE]') s3,
+     (SELECT COUNT(*) AS h10_to_10_30
+      FROM store_sales, household_demographics, time_dim, store
+      WHERE ss_sold_time_sk = time_dim.t_time_sk
+        AND ss_hdemo_sk = household_demographics.hd_demo_sk
+        AND ss_store_sk = s_store_sk
+        AND time_dim.t_hour = 10
+        AND time_dim.t_minute < 30
+        AND ((household_demographics.hd_dep_count = [DEP1]
+              AND household_demographics.hd_vehicle_count <= [DEP1] + 2)
+             OR (household_demographics.hd_dep_count = [DEP2]
+                 AND household_demographics.hd_vehicle_count <= [DEP2] + 2)
+             OR (household_demographics.hd_dep_count = [DEP3]
+                 AND household_demographics.hd_vehicle_count <= [DEP3] + 2))
+        AND store.s_store_name = '[STORE]') s4,
+     (SELECT COUNT(*) AS h10_30_to_11
+      FROM store_sales, household_demographics, time_dim, store
+      WHERE ss_sold_time_sk = time_dim.t_time_sk
+        AND ss_hdemo_sk = household_demographics.hd_demo_sk
+        AND ss_store_sk = s_store_sk
+        AND time_dim.t_hour = 10
+        AND time_dim.t_minute >= 30
+        AND ((household_demographics.hd_dep_count = [DEP1]
+              AND household_demographics.hd_vehicle_count <= [DEP1] + 2)
+             OR (household_demographics.hd_dep_count = [DEP2]
+                 AND household_demographics.hd_vehicle_count <= [DEP2] + 2)
+             OR (household_demographics.hd_dep_count = [DEP3]
+                 AND household_demographics.hd_vehicle_count <= [DEP3] + 2))
+        AND store.s_store_name = '[STORE]') s5,
+     (SELECT COUNT(*) AS h11_to_11_30
+      FROM store_sales, household_demographics, time_dim, store
+      WHERE ss_sold_time_sk = time_dim.t_time_sk
+        AND ss_hdemo_sk = household_demographics.hd_demo_sk
+        AND ss_store_sk = s_store_sk
+        AND time_dim.t_hour = 11
+        AND time_dim.t_minute < 30
+        AND ((household_demographics.hd_dep_count = [DEP1]
+              AND household_demographics.hd_vehicle_count <= [DEP1] + 2)
+             OR (household_demographics.hd_dep_count = [DEP2]
+                 AND household_demographics.hd_vehicle_count <= [DEP2] + 2)
+             OR (household_demographics.hd_dep_count = [DEP3]
+                 AND household_demographics.hd_vehicle_count <= [DEP3] + 2))
+        AND store.s_store_name = '[STORE]') s6,
+     (SELECT COUNT(*) AS h11_30_to_12
+      FROM store_sales, household_demographics, time_dim, store
+      WHERE ss_sold_time_sk = time_dim.t_time_sk
+        AND ss_hdemo_sk = household_demographics.hd_demo_sk
+        AND ss_store_sk = s_store_sk
+        AND time_dim.t_hour = 11
+        AND time_dim.t_minute >= 30
+        AND ((household_demographics.hd_dep_count = [DEP1]
+              AND household_demographics.hd_vehicle_count <= [DEP1] + 2)
+             OR (household_demographics.hd_dep_count = [DEP2]
+                 AND household_demographics.hd_vehicle_count <= [DEP2] + 2)
+             OR (household_demographics.hd_dep_count = [DEP3]
+                 AND household_demographics.hd_vehicle_count <= [DEP3] + 2))
+        AND store.s_store_name = '[STORE]') s7,
+     (SELECT COUNT(*) AS h12_to_12_30
+      FROM store_sales, household_demographics, time_dim, store
+      WHERE ss_sold_time_sk = time_dim.t_time_sk
+        AND ss_hdemo_sk = household_demographics.hd_demo_sk
+        AND ss_store_sk = s_store_sk
+        AND time_dim.t_hour = 12
+        AND time_dim.t_minute < 30
+        AND ((household_demographics.hd_dep_count = [DEP1]
+              AND household_demographics.hd_vehicle_count <= [DEP1] + 2)
+             OR (household_demographics.hd_dep_count = [DEP2]
+                 AND household_demographics.hd_vehicle_count <= [DEP2] + 2)
+             OR (household_demographics.hd_dep_count = [DEP3]
+                 AND household_demographics.hd_vehicle_count <= [DEP3] + 2))
+        AND store.s_store_name = '[STORE]') s8

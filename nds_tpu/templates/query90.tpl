@@ -1,0 +1,22 @@
+-- define [HOUR_AM] = uniform_int(6, 12)
+-- define [HOUR_PM] = uniform_int(13, 21)
+-- define [DEP] = uniform_int(0, 6)
+SELECT CAST(amc AS DOUBLE) / CAST(pmc AS DOUBLE) AS am_pm_ratio
+FROM (SELECT COUNT(*) AS amc
+      FROM web_sales, household_demographics, time_dim, web_page
+      WHERE ws_sold_time_sk = time_dim.t_time_sk
+        AND ws_ship_hdemo_sk = household_demographics.hd_demo_sk
+        AND ws_web_page_sk = web_page.wp_web_page_sk
+        AND time_dim.t_hour BETWEEN [HOUR_AM] AND [HOUR_AM] + 1
+        AND household_demographics.hd_dep_count = [DEP]
+        AND web_page.wp_char_count BETWEEN 5000 AND 5200) at_,
+     (SELECT COUNT(*) AS pmc
+      FROM web_sales, household_demographics, time_dim, web_page
+      WHERE ws_sold_time_sk = time_dim.t_time_sk
+        AND ws_ship_hdemo_sk = household_demographics.hd_demo_sk
+        AND ws_web_page_sk = web_page.wp_web_page_sk
+        AND time_dim.t_hour BETWEEN [HOUR_PM] AND [HOUR_PM] + 1
+        AND household_demographics.hd_dep_count = [DEP]
+        AND web_page.wp_char_count BETWEEN 5000 AND 5200) pt
+ORDER BY am_pm_ratio
+LIMIT 100

@@ -1,0 +1,18 @@
+-- define [IMID] = uniform_int(1, 1000)
+-- define [SDATE] = rand_date(1998, 2002)
+SELECT SUM(ws_ext_discount_amt) AS excess_discount_amount
+FROM web_sales, item, date_dim
+WHERE i_manufact_id = [IMID]
+  AND i_item_sk = ws_item_sk
+  AND d_date BETWEEN CAST('[SDATE]' AS DATE)
+                 AND (CAST('[SDATE]' AS DATE) + INTERVAL 90 DAYS)
+  AND d_date_sk = ws_sold_date_sk
+  AND ws_ext_discount_amt >
+      (SELECT 1.3 * AVG(ws_ext_discount_amt)
+       FROM web_sales, date_dim
+       WHERE ws_item_sk = i_item_sk
+         AND d_date BETWEEN CAST('[SDATE]' AS DATE)
+                        AND (CAST('[SDATE]' AS DATE) + INTERVAL 90 DAYS)
+         AND d_date_sk = ws_sold_date_sk)
+ORDER BY SUM(ws_ext_discount_amt)
+LIMIT 100

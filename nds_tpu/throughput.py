@@ -1,0 +1,147 @@
+"""Throughput test: run N query streams concurrently.
+
+Capability parity with the reference throughput harness (reference
+nds/nds-throughput: xargs -P fans one full Spark app per stream;
+nds/nds_bench.py:138-157 computes elapsed = max(stream end) - min(stream
+start) by scraping the per-stream time logs). Here each stream is a full
+power run; ``process`` mode launches one OS process per stream (the
+reference's N-concurrent-apps shape — separate interpreters so the
+streams contend only for the device, not the GIL), ``thread`` mode
+multiplexes in-process sessions onto one device (cheap for tests and for
+sharing a single compiled-query cache).
+"""
+from __future__ import annotations
+
+import argparse
+import csv
+import os
+import subprocess
+import sys
+from concurrent.futures import ThreadPoolExecutor
+
+
+def stream_log_path(time_log_dir: str, stream: int) -> str:
+    return os.path.join(time_log_dir, f"throughput_{stream}.csv")
+
+
+def _run_stream_thread(input_prefix: str, stream_file: str, time_log: str,
+                       **kwargs) -> None:
+    from .power import run_query_stream
+    run_query_stream(input_prefix, stream_file, time_log, **kwargs)
+
+
+def _stream_cmd(input_prefix: str, stream_file: str, time_log: str,
+                input_format: str, output_prefix: str | None,
+                json_summary_folder: str | None,
+                sub_queries: list[str] | None,
+                property_file: str | None, backend: str | None) -> list[str]:
+    cmd = [sys.executable, "-m", "nds_tpu.power", input_prefix, stream_file,
+           time_log, "--input_format", input_format]
+    if output_prefix:
+        cmd += ["--output_prefix", output_prefix]
+    if json_summary_folder:
+        cmd += ["--json_summary_folder", json_summary_folder]
+    if sub_queries:
+        cmd += ["--sub_queries", ",".join(sub_queries)]
+    if property_file:
+        cmd += ["--property_file", property_file]
+    if backend:
+        cmd += ["--backend", backend]
+    return cmd
+
+
+def run_throughput(input_prefix: str, stream_dir: str, streams: list[int],
+                   time_log_dir: str,
+                   input_format: str = "parquet",
+                   output_prefix: str | None = None,
+                   json_summary_folder: str | None = None,
+                   sub_queries: list[str] | None = None,
+                   property_file: str | None = None,
+                   backend: str | None = None,
+                   mode: str = "process") -> float:
+    """Run the given streams concurrently; returns elapsed seconds.
+
+    Elapsed is max(stream Power End) - min(stream Power Start) over the
+    written time logs, the reference's definition (nds_bench.py:138-157).
+    """
+    os.makedirs(time_log_dir, exist_ok=True)
+    jobs = []
+    for s in streams:
+        stream_file = os.path.join(stream_dir, f"query_{s}.sql")
+        log = stream_log_path(time_log_dir, s)
+        out = os.path.join(output_prefix, f"stream_{s}") \
+            if output_prefix else None
+        jobs.append((stream_file, log, out))
+
+    if mode == "process":
+        procs = [subprocess.Popen(
+            _stream_cmd(input_prefix, sf, log, input_format, out,
+                        json_summary_folder, sub_queries, property_file,
+                        backend))
+            for sf, log, out in jobs]
+        failed = [p.args for p in procs if p.wait() != 0]
+        if failed:
+            raise RuntimeError(f"throughput streams failed: {failed}")
+    else:
+        with ThreadPoolExecutor(max_workers=len(jobs)) as pool:
+            futures = [pool.submit(
+                _run_stream_thread, input_prefix, sf, log,
+                input_format=input_format, output_prefix=out,
+                json_summary_folder=json_summary_folder,
+                sub_queries=sub_queries, property_file=property_file,
+                backend=backend)
+                for sf, log, out in jobs]
+            for f in futures:
+                f.result()
+
+    return throughput_elapsed([log for _, log, _ in jobs])
+
+
+def scrape_log(time_log: str) -> tuple[int, int]:
+    """Return (power start ms, power end ms) from a power-run time log."""
+    start = end = None
+    with open(time_log) as f:
+        for row in csv.reader(f):
+            if not row:
+                continue
+            if row[0] == "Power Start Time":
+                start = int(row[1])
+            elif row[0] == "Power End Time":
+                end = int(row[1])
+    if start is None or end is None:
+        raise ValueError(f"no sentinel rows in {time_log}")
+    return start, end
+
+
+def throughput_elapsed(time_logs: list[str]) -> float:
+    spans = [scrape_log(p) for p in time_logs]
+    return (max(e for _, e in spans) - min(s for s, _ in spans)) / 1000.0
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="nds_tpu.throughput")
+    p.add_argument("input_prefix")
+    p.add_argument("stream_dir")
+    p.add_argument("streams", help="comma-separated stream ids, e.g. 1,2,3,4")
+    p.add_argument("time_log_dir")
+    p.add_argument("--input_format", default="parquet")
+    p.add_argument("--output_prefix", default=None)
+    p.add_argument("--json_summary_folder", default=None)
+    p.add_argument("--sub_queries", default=None)
+    p.add_argument("--property_file", default=None)
+    p.add_argument("--backend", default=None, choices=["jax", "numpy"])
+    p.add_argument("--mode", default="process",
+                   choices=["process", "thread"])
+    a = p.parse_args(argv)
+    ids = [int(s) for s in a.streams.split(",")]
+    sub = a.sub_queries.split(",") if a.sub_queries else None
+    elapsed = run_throughput(a.input_prefix, a.stream_dir, ids,
+                             a.time_log_dir, a.input_format, a.output_prefix,
+                             a.json_summary_folder, sub, a.property_file,
+                             a.backend, a.mode)
+    print(f"Throughput Test Time: {elapsed:.3f} seconds")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -128,22 +128,33 @@ class WarehouseTable:
     def delete_where(self, keep_filter) -> dict:
         """Rewrite files keeping rows where keep_filter(table) is True.
 
-        keep_filter: callable(pa.Table) -> pa.BooleanArray of rows to KEEP.
-        Files with nothing deleted are reused untouched.
+        keep_filter: callable(pa.Table) -> pa.BooleanArray of rows to KEEP,
+        called ONCE over the concatenation of all current files (in
+        current_files() order). Files with nothing deleted are reused
+        untouched; the rest are rewritten from their kept slice.
         """
+        import pyarrow.compute as pc
+
+        paths = self.current_files()
+        if not paths:
+            return self._commit([])
+        tables = [pq.read_table(p) for p in paths]
+        whole = pa.concat_tables(tables, promote_options="permissive")
+        keep = pa.array(keep_filter(whole), type=pa.bool_())
+
         new_files = []
-        for path in self.current_files():
-            t = pq.read_table(path)
-            keep = keep_filter(t)
-            import pyarrow.compute as pc
-            n_keep = pc.sum(pc.cast(keep, pa.int64())).as_py() or 0
+        offset = 0
+        for path, t in zip(paths, tables):
+            part = keep.slice(offset, t.num_rows)
+            offset += t.num_rows
+            n_keep = pc.sum(pc.cast(part, pa.int64())).as_py() or 0
             rel = os.path.relpath(path, self.dir)
             if n_keep == t.num_rows:
                 new_files.append(rel)
                 continue
             if n_keep == 0:
                 continue
-            kept = t.filter(keep)
+            kept = t.filter(part)
             base = f"part-{uuid.uuid4().hex[:12]}.parquet"
             new_rel = os.path.join(os.path.dirname(rel), base)
             pq.write_table(kept, os.path.join(self.dir, new_rel))

@@ -1,0 +1,70 @@
+"""Orchestrator tests: metric arithmetic, stream ranges, scraping, and a
+tiny full-process bench run (reference nds/nds_bench.py behaviors)."""
+import csv
+import math
+import os
+
+import pytest
+
+from nds_tpu import bench
+
+
+def test_round_up_tenth():
+    assert bench.round_up_tenth(1.01) == 1.1
+    assert bench.round_up_tenth(1.10) == 1.1
+    assert bench.round_up_tenth(0.001) == 0.1
+
+
+def test_stream_ranges():
+    assert bench.get_stream_range(9, 1) == [1, 2, 3, 4]
+    assert bench.get_stream_range(9, 2) == [5, 6, 7, 8]
+    assert bench.get_stream_range(3, 1) == [1]
+    assert bench.get_stream_range(3, 2) == [2]
+    with pytest.raises(ValueError):
+        bench.get_stream_range(4, 1)
+
+
+def test_perf_metric_formula():
+    # SF=100, 9 streams (Sq=4), all phase times 1 hour in seconds
+    got = bench.get_perf_metric(100, 9, 3600, 3600, 1800, 1800, 1800, 1800)
+    t_ld = 0.01 * 4 * 1.0
+    t_pt = 4.0
+    t_tt = 1.0
+    t_dm = 1.0
+    want = math.floor(100 * (4 * 99) / (t_pt * t_tt * t_dm * t_ld) ** 0.25)
+    assert got == want
+
+
+def test_full_bench_tiny(tmp_path):
+    cfg = {
+        "backend": "numpy",
+        "report_dir": str(tmp_path / "report"),
+        "sub_queries": ["query1", "query3", "query42"],
+        "data_gen": {"scale_factor": 0.001, "parallel": 2,
+                     "data_path": str(tmp_path / "data")},
+        "load_test": {"warehouse_path": str(tmp_path / "wh"),
+                      "format": "parquet"},
+        "generate_query_stream": {"num_streams": 3,
+                                  "stream_path": str(tmp_path / "streams")},
+        "power_test": {},
+        "throughput_test": {"mode": "thread"},
+        "maintenance_test": {},
+    }
+    result = bench.run_full_bench(cfg)
+    assert result["metric"] > 0
+    for k in ("load", "power", "throughput1", "throughput2",
+              "maintenance1", "maintenance2"):
+        assert result[k] >= 0.1  # rounded up to 0.1s resolution
+
+    metrics = tmp_path / "report" / "metrics.csv"
+    assert metrics.exists()
+    rows = {r[0]: r[1] for r in csv.reader(open(metrics))}
+    assert rows["Sq"] == "1"
+    assert float(rows["perf_metric"]) == result["metric"]
+
+    # skip-flag resume: rerun with every phase skipped, scraping only
+    for section in ("data_gen", "load_test", "generate_query_stream",
+                    "power_test", "throughput_test", "maintenance_test"):
+        cfg[section]["skip"] = True
+    again = bench.run_full_bench(cfg)
+    assert again["metric"] == result["metric"]

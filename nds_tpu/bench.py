@@ -153,7 +153,9 @@ def run_full_bench(cfg: dict) -> dict:
     # step 2: query streams seeded by the load end timestamp
     qs_cfg = cfg["generate_query_stream"]
     if not _skip(qs_cfg):
-        rngseed = qs_cfg.get("rngseed") or get_load_end_timestamp(load_report)
+        rngseed = qs_cfg.get("rngseed")
+        if rngseed is None:  # an explicit seed of 0 must be honored
+            rngseed = get_load_end_timestamp(load_report)
         streams.generate_query_streams(stream_dir, streams=num_streams,
                                        rngseed=int(rngseed))
 

@@ -7,7 +7,15 @@ are still accepted for interface parity (`load_properties`).
 """
 from __future__ import annotations
 
-from dataclasses import dataclass
+import os
+from dataclasses import dataclass, field
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.lower() not in ("0", "false", "no")
 
 
 @dataclass
@@ -22,6 +30,10 @@ class EngineConfig:
     chunk_rows: int = 1 << 20
     # run jitted per-op kernels (True) or pure-numpy fallback (False, debug only)
     use_jax: bool = True
+    # compile whole plans to one XLA program on re-execution (record/replay);
+    # NDS_TPU_JIT_PLANS=0 disables globally (e.g. compile-bound CI runs)
+    jit_plans: bool = field(default_factory=lambda: _env_bool(
+        "NDS_TPU_JIT_PLANS", True))
 
     @staticmethod
     def from_property_file(path: str | None) -> "EngineConfig":
@@ -66,3 +78,17 @@ def enable_x64() -> None:
     import jax
 
     jax.config.update("jax_enable_x64", True)
+
+
+def enable_compile_cache(path: str | None = None) -> None:
+    """Persist XLA compilations on disk (kernels recur across sessions with
+    the same shape buckets, so a query stream's compile cost is paid once).
+    """
+    import jax
+
+    cache_dir = path or os.environ.get(
+        "NDS_TPU_COMPILE_CACHE", os.path.join(os.path.expanduser("~"),
+                                              ".cache", "nds_tpu_xla"))
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)

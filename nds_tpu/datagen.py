@@ -69,15 +69,20 @@ def generate_data_local(data_dir: str, scale: float, parallel: int,
                         overwrite: bool = False) -> None:
     """Fork one generator process per chunk and lay out per-table dirs."""
     binary = binary or check_build()
-    if os.path.exists(data_dir):
-        if not overwrite and os.listdir(data_dir):
-            raise FileExistsError(
-                f"{data_dir} is not empty; pass overwrite to replace")
-        shutil.rmtree(data_dir, ignore_errors=True)
-    work = os.path.join(data_dir, "_raw_")
-    os.makedirs(work, exist_ok=True)
-
     first, last = chunk_range if chunk_range else (1, parallel)
+    if chunk_range is None:
+        if os.path.exists(data_dir):
+            if not overwrite and os.listdir(data_dir):
+                raise FileExistsError(
+                    f"{data_dir} is not empty; pass overwrite to replace")
+            shutil.rmtree(data_dir, ignore_errors=True)
+        work = os.path.join(data_dir, "_raw_")
+    else:
+        # incremental range runs append into a shared data_dir (possibly
+        # concurrently from several hosts): never wipe it, and keep a
+        # range-private work dir so parallel runs don't race on cleanup
+        work = os.path.join(data_dir, f"_raw_{first}_{last}_")
+    os.makedirs(work, exist_ok=True)
     procs = []
     for child in range(first, last + 1):
         cmd = [binary, "-scale", str(scale), "-dir", work,
@@ -105,11 +110,18 @@ def generate_data_local(data_dir: str, scale: float, parallel: int,
                 os.rename(src, os.path.join(tdir, f"{table}.dat"))
     shutil.rmtree(work, ignore_errors=True)
 
-    # verify non-empty output (reference nds_gen_data.py:199-206)
-    for table in tables:
-        tdir = os.path.join(data_dir, table)
-        if not os.listdir(tdir):
-            raise RuntimeError(f"no output produced for table {table}")
+    # verify non-empty output (reference nds_gen_data.py:199-206); a range
+    # subset legitimately leaves small single-chunk tables to other ranges,
+    # so full verification only applies to whole runs
+    if chunk_range is None:
+        for table in tables:
+            tdir = os.path.join(data_dir, table)
+            if not os.listdir(tdir):
+                raise RuntimeError(f"no output produced for table {table}")
+    elif not any(os.listdir(os.path.join(data_dir, t)) for t in tables
+                 if os.path.isdir(os.path.join(data_dir, t))):
+        raise RuntimeError(
+            f"range {first},{last} produced no output for any table")
 
 
 def generate_data_hosts(data_dir: str, scale: float, parallel: int,
@@ -127,8 +139,10 @@ def generate_data_hosts(data_dir: str, scale: float, parallel: int,
         last = parallel * (i + 1) // n
         if first > last:
             continue
+        # NOTE: no --overwrite — range runs append into the shared dir; a
+        # wipe here would race the other hosts' output away
         sub = (f"python -m nds_tpu.datagen local {data_dir} --scale {scale} "
-               f"--parallel {parallel} --range {first},{last} --overwrite")
+               f"--parallel {parallel} --range {first},{last}")
         if update:
             sub += f" --update {update}"
         procs.append(subprocess.Popen(["ssh", host, sub]))

@@ -59,8 +59,12 @@ def from_arrow_column(arr) -> Column:
         dictionary = np.asarray(arr.dictionary.to_pylist(), dtype=object)
         return Column("str", codes, valid, dictionary)
     if dtype == "date":
-        days = arr.cast(pa.int32()).to_numpy(zero_copy_only=False)
         valid = ~np.asarray(arr.is_null()) if null_count else None
+        ints = arr.cast(pa.int32())
+        if null_count:  # fill BEFORE to_numpy: nulls otherwise round-trip
+            import pyarrow.compute as pc  # through float NaN -> int garbage
+            ints = pc.fill_null(ints, 0)
+        days = ints.to_numpy(zero_copy_only=False)
         return Column("date", np.asarray(days, dtype=np.int32), valid)
     if dtype == "float":
         if pa.types.is_decimal(t):

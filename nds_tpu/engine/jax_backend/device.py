@@ -76,11 +76,59 @@ class DTable:
         return jnp.sum(self.alive.astype(jnp.int32))
 
 
+# -- pytree registration ------------------------------------------------------
+# DCol/DTable flow through jax.jit as arguments and results of compiled whole
+# -plan programs (executor.CompiledQuery). Dictionaries are host-side objects:
+# they ride in aux_data, hashable by identity (scan caches keep them stable
+# across calls, so jit cache keys match).
+
+class _ById:
+    """Identity-hashed wrapper so host objects can sit in pytree aux_data."""
+    __slots__ = ("obj",)
+
+    def __init__(self, obj):
+        self.obj = obj
+
+    def __hash__(self):
+        return id(self.obj)
+
+    def __eq__(self, other):
+        return isinstance(other, _ById) and other.obj is self.obj
+
+
+def _dcol_flatten(c: DCol):
+    return (c.data, c.valid, c.parts), (c.dtype, _ById(c.dictionary))
+
+
+def _dcol_unflatten(aux, children):
+    data, valid, parts = children
+    return DCol(aux[0], data, valid, aux[1].obj, parts)
+
+
+def _dtable_flatten(t: DTable):
+    return (t.cols, t.alive), tuple(t.names)
+
+
+def _dtable_unflatten(aux, children):
+    cols, alive = children
+    return DTable(list(aux), cols, alive)
+
+
+jax.tree_util.register_pytree_node(DCol, _dcol_flatten, _dcol_unflatten)
+jax.tree_util.register_pytree_node(DTable, _dtable_flatten, _dtable_unflatten)
+
+
 # -- host <-> device bridging ------------------------------------------------
 
-def to_device(table: Table, capacity: Optional[int] = None) -> DTable:
+def to_device(table: Table, capacity: Optional[int] = None,
+              device=None) -> DTable:
     n = table.num_rows
     cap = capacity if capacity is not None else bucket(n)
+
+    def put(arr):
+        return jnp.asarray(arr) if device is None \
+            else jax.device_put(arr, device)
+
     cols = []
     for c in table.columns:
         data = np.asarray(c.data)
@@ -92,14 +140,20 @@ def to_device(table: Table, capacity: Optional[int] = None) -> DTable:
         if c.dtype == "str":
             # canonical null slot for codes is 0 (valid=False marks them)
             buf[:n] = np.where(c.validity & (data >= 0), data, 0)
-        cols.append(DCol(c.dtype, jnp.asarray(buf), jnp.asarray(v), c.dictionary))
+        cols.append(DCol(c.dtype, put(buf), put(v), c.dictionary))
     alive = np.zeros(cap, dtype=bool)
     alive[:n] = True
-    return DTable(list(table.names), cols, jnp.asarray(alive))
+    return DTable(list(table.names), cols, put(alive))
 
 
 def to_host(dt: DTable, count: Optional[int] = None) -> Table:
-    """Materialize a device table back into a host Table (compacted)."""
+    """Materialize a device table back into a host Table (compacted).
+
+    All buffers come back in ONE device_get: on tunneled platforms each
+    D2H transfer pays a fixed RTT, so per-column np.asarray would multiply
+    that latency by the column count.
+    """
+    dt = jax.device_get(dt)
     alive = np.asarray(dt.alive)
     idx = np.flatnonzero(alive)
     if count is not None:

@@ -38,7 +38,8 @@ def evaluate(expr: BExpr, table: DTable,
     if isinstance(expr, BScalarSubquery):
         if subquery_eval is None:
             raise RuntimeError("scalar subquery encountered without evaluator")
-        return constant(expr.dtype, subquery_eval(expr.plan), n)
+        value, valid = subquery_eval(expr.plan)
+        return constant(expr.dtype, value, n, valid)
     if isinstance(expr, BCall):
         handler = _HANDLERS.get(expr.op)
         if handler is None:
@@ -47,8 +48,14 @@ def evaluate(expr: BExpr, table: DTable,
     raise TypeError(type(expr).__name__)
 
 
-def constant(dtype: str, value, n: int) -> DCol:
+def constant(dtype: str, value, n: int, valid=None) -> DCol:
+    """Broadcast a scalar to a column. `valid` None => nullness from `value`
+    (host python scalar); otherwise a traced 0-d validity (scalar subqueries
+    inlined into a compiled plan)."""
     pd = phys_dtype(dtype)
+    if valid is not None:
+        data = jnp.broadcast_to(jnp.asarray(value).astype(pd), (n,))
+        return DCol(dtype, data, jnp.broadcast_to(valid, (n,)))
     if value is None:
         return DCol(dtype, jnp.zeros(n, pd), jnp.zeros(n, bool))
     if dtype == "str":
@@ -316,10 +323,34 @@ def _cast(expr: BCall, table: DTable, sq) -> DCol:
     if a.dtype == "str":
         return _cast_from_str(a, target)
     if target == "str":
-        raise NotImplementedError("cast to string on device")
+        return _cast_to_str(a)
     if target in ("int", "float", "date"):
         return DCol(target, a.data.astype(phys_dtype(target)), a.valid)
     raise NotImplementedError(f"cast to {target}")
+
+
+def _cast_to_str(a: DCol) -> DCol:
+    """Numeric/date -> string: dictionary-encode the distinct values on host.
+
+    The output dictionary is data-dependent, so this runs eagerly only; a
+    traced input aborts plan compilation (executor falls back to eager for
+    such plans).
+    """
+    if isinstance(a.data, jax.core.Tracer):
+        raise NotImplementedError(
+            "cast to string needs a data-dependent dictionary (host)")
+    from ..exprs import _sql_str
+
+    data = np.asarray(a.data)
+    uniq_raw, inverse = np.unique(data, return_inverse=True)
+    if a.dtype == "date":
+        strs = [str(np.datetime64(int(v), "D").item()) for v in uniq_raw]
+    else:
+        strs = [_sql_str(v) for v in uniq_raw]
+    uniq, remap = np.unique(np.asarray(strs, dtype=object).astype(str),
+                            return_inverse=True)
+    codes = remap.astype(np.int32)[inverse]
+    return DCol("str", jnp.asarray(codes), a.valid, uniq.astype(object))
 
 
 def _cast_from_str(a: DCol, target: str) -> DCol:

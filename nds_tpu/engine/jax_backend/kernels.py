@@ -94,22 +94,32 @@ def limit_alive(alive: jax.Array, n_keep: int) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 def sort_perm(key_data: list[jax.Array], key_valid: list[jax.Array],
-              keys: list[SortKey], alive: jax.Array) -> jax.Array:
-    """Permutation realizing Spark ORDER BY semantics; dead rows go last."""
+              key_specs: tuple, alive: jax.Array) -> jax.Array:
+    """Permutation realizing Spark ORDER BY semantics; dead rows go last.
+
+    key_specs: static tuple of (asc, nulls_first) per key (nulls_first may
+    be None => Spark default: asc nulls first, desc nulls last).
+    """
     n = alive.shape[0]
     operands: list[jax.Array] = [(~alive).astype(_I32)]
-    for col, valid, k in zip(key_data, key_valid, keys):
-        nulls_first = k.nulls_first if k.nulls_first is not None else k.asc
+    for col, valid, (asc, nulls_first) in zip(key_data, key_valid, key_specs):
+        if nulls_first is None:
+            nulls_first = asc
         # null rank: 0 => before values, 2 => after values; values rank 1
         null_rank = jnp.where(valid, 1, 0 if nulls_first else 2).astype(_I32)
         operands.append(null_rank)
         d = jnp.where(valid & alive, col, jnp.zeros((), col.dtype))
-        if not k.asc:
+        if not asc:
             d = (~d) if d.dtype == jnp.bool_ else -d
         operands.append(d)
     out = lax.sort(tuple(operands) + (_iota(n),), num_keys=len(operands),
                    is_stable=True)
     return out[-1]
+
+
+def sort_specs(keys: list[SortKey]) -> tuple:
+    """Static (asc, nulls_first) tuple for sort_perm from bound SortKeys."""
+    return tuple((k.asc, k.nulls_first) for k in keys)
 
 
 # ---------------------------------------------------------------------------
@@ -126,66 +136,54 @@ def _seg(data: jax.Array, gid: jax.Array, num_segments: int, op: str) -> jax.Arr
     raise AssertionError(op)
 
 
+def agg_apply(gid: jax.Array, alive: jax.Array, func: str, arg,
+              cap_out: int) -> tuple[jax.Array, jax.Array]:
+    """One per-group aggregate. `arg` is a (data, valid) tuple or None.
+
+    Returns (values, valid), each length cap_out. gid for dead rows must be
+    >= cap_out so their contributions fall outside the segment range.
+    """
+    int_out = jnp.int64 if jax.config.read("jax_enable_x64") else _I32
+    if func == "count_star":
+        ones = jnp.ones_like(alive, dtype=_I32)
+        vals = jax.ops.segment_sum(jnp.where(alive, ones, 0), gid,
+                                   num_segments=cap_out)
+        return vals.astype(int_out), jnp.ones(cap_out, bool)
+    data, valid = arg
+    contrib = alive & valid
+    cnt = jax.ops.segment_sum(contrib.astype(int_out), gid,
+                              num_segments=cap_out)
+    if func == "count":
+        return cnt, jnp.ones(cap_out, bool)
+    if func == "sum":
+        z = jnp.where(contrib, data, jnp.zeros((), data.dtype))
+        return _seg(z, gid, cap_out, "sum"), cnt > 0
+    if func in ("min", "max"):
+        big = _extreme(data.dtype, func)
+        z = jnp.where(contrib, data, big)
+        vals = _seg(z, gid, cap_out, func)
+        vals = jnp.where(cnt > 0, vals, jnp.zeros((), data.dtype))
+        return vals, cnt > 0
+    if func == "avg":
+        z = jnp.where(contrib, data, jnp.zeros((), data.dtype)).astype(
+            _float_dtype())
+        s = _seg(z, gid, cap_out, "sum")
+        return s / jnp.maximum(cnt, 1).astype(_float_dtype()), cnt > 0
+    if func == "stddev_samp":
+        zf = jnp.where(contrib, data, 0).astype(_float_dtype())
+        s = _seg(zf, gid, cap_out, "sum")
+        s2 = _seg(zf * zf, gid, cap_out, "sum")
+        nf = cnt.astype(_float_dtype())
+        var = (s2 - s * s / jnp.maximum(nf, 1.0)) / jnp.maximum(nf - 1.0, 1.0)
+        return jnp.sqrt(jnp.maximum(var, 0.0)), cnt > 1
+    raise NotImplementedError(f"device agg {func}")
+
+
 def aggregate(gid: jax.Array, alive: jax.Array, specs: list[AggSpec],
               args: list, cap_out: int) -> list[tuple[jax.Array, jax.Array]]:
-    """Per-group aggregates. `args` are (data, valid) tuples or None.
-
-    Returns one (values, valid) per spec, each length cap_out. gid for dead
-    rows must be >= cap_out (the sentinel from dense_rank works when
-    cap_out == capacity + 1 is NOT required — callers pass num_segments-safe
-    capacity; dead rows land in segment `capacity` and callers slice).
-    """
-    results = []
-    counts_cache: dict[int, jax.Array] = {}
-
-    def contrib_count(valid):
-        key = id(valid)
-        if key not in counts_cache:
-            counts_cache[key] = jax.ops.segment_sum(
-                (alive & valid).astype(jnp.int64 if jax.config.read("jax_enable_x64")
-                 else _I32), gid, num_segments=cap_out)
-        return counts_cache[key]
-
-    for spec, arg in zip(specs, args):
-        if spec.func == "count_star":
-            ones = jnp.ones_like(alive, dtype=_I32)
-            vals = jax.ops.segment_sum(jnp.where(alive, ones, 0), gid,
-                                       num_segments=cap_out)
-            results.append((vals.astype(jnp.int64) if jax.config.read("jax_enable_x64")
-                            else vals, jnp.ones(cap_out, bool)))
-            continue
-        data, valid = arg
-        contrib = alive & valid
-        cnt = contrib_count(valid)
-        if spec.func == "count":
-            results.append((cnt, jnp.ones(cap_out, bool)))
-        elif spec.func == "sum":
-            z = jnp.where(contrib, data, jnp.zeros((), data.dtype))
-            vals = _seg(z, gid, cap_out, "sum")
-            results.append((vals, cnt > 0))
-        elif spec.func in ("min", "max"):
-            big = _extreme(data.dtype, spec.func)
-            z = jnp.where(contrib, data, big)
-            vals = _seg(z, gid, cap_out, spec.func)
-            vals = jnp.where(cnt > 0, vals, jnp.zeros((), data.dtype))
-            results.append((vals, cnt > 0))
-        elif spec.func == "avg":
-            z = jnp.where(contrib, data, jnp.zeros((), data.dtype)).astype(
-                _float_dtype())
-            s = _seg(z, gid, cap_out, "sum")
-            vals = s / jnp.maximum(cnt, 1).astype(_float_dtype())
-            results.append((vals, cnt > 0))
-        elif spec.func == "stddev_samp":
-            zf = jnp.where(contrib, data, 0).astype(_float_dtype())
-            s = _seg(zf, gid, cap_out, "sum")
-            s2 = _seg(zf * zf, gid, cap_out, "sum")
-            nf = cnt.astype(_float_dtype())
-            var = (s2 - s * s / jnp.maximum(nf, 1.0)) / jnp.maximum(nf - 1.0, 1.0)
-            vals = jnp.sqrt(jnp.maximum(var, 0.0))
-            results.append((vals, cnt > 1))
-        else:
-            raise NotImplementedError(f"device agg {spec.func}")
-    return results
+    """Multi-spec wrapper over agg_apply (kept for call-site compatibility)."""
+    return [agg_apply(gid, alive, spec.func, arg, cap_out)
+            for spec, arg in zip(specs, args)]
 
 
 def _float_dtype():
@@ -224,6 +222,94 @@ def distinct_within_group(gid: jax.Array, alive: jax.Array,
 
 
 # ---------------------------------------------------------------------------
+# window functions
+# ---------------------------------------------------------------------------
+
+def _seg_scan(vals: jax.Array, new_part: jax.Array, op) -> jax.Array:
+    """Inclusive within-segment scan of `op` (reset at new_part) — the
+    classic reset-semiring associative_scan, TPU-friendly (log-depth)."""
+    def comb(a, b):
+        fa, va = a
+        fb, vb = b
+        return fa | fb, jnp.where(fb, vb, op(va, vb))
+    _, out = lax.associative_scan(comb, (new_part, vals))
+    return out
+
+
+def window_ordered_core(sgid: jax.Array, tie_data: list[jax.Array],
+                        tie_valid: list[jax.Array], arg, func: str
+                        ) -> tuple[jax.Array, jax.Array]:
+    """Ordered-window values over rows ALREADY sorted by (partition, order).
+
+    sgid: sorted partition ids (dead rows hold a trailing sentinel id).
+    tie_data/tie_valid: sorted order-key columns for RANGE tie detection.
+    arg: (data, valid) in sorted order, or None (rank family / count_star).
+    Returns (values, valid) in sorted order; caller scatters back via the
+    sort permutation and masks by `alive`. RANGE frame semantics: every row
+    of a tie run takes the run's last cumulative value (Spark default
+    RANGE BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW).
+    """
+    n = sgid.shape[0]
+    iota = _iota(n)
+    true1 = jnp.ones(1, bool)
+    new_part = jnp.concatenate([true1, sgid[1:] != sgid[:-1]])
+    same = jnp.ones(n, bool)
+    for d, v in zip(tie_data, tie_valid):
+        eq = jnp.concatenate([jnp.zeros(1, bool),
+                              (d[1:] == d[:-1]) & (v[1:] == v[:-1])])
+        same = same & eq
+    same = same & ~new_part
+    # index of the row's partition start / tie-run start (starts are
+    # monotically increasing, so a global cummax over flagged indices works)
+    part_start = lax.cummax(jnp.where(new_part, iota, 0))
+    pos_in_part = iota - part_start
+
+    if func == "row_number":
+        return pos_in_part + 1, jnp.ones(n, bool)
+    if func == "rank":
+        run_start = lax.cummax(jnp.where(~same, iota, 0))
+        return run_start - part_start + 1, jnp.ones(n, bool)
+    if func == "dense_rank":
+        bump = (~same) & ~new_part
+        cb = jnp.cumsum(bump.astype(_I32))
+        return cb - cb[part_start] + 1, jnp.ones(n, bool)
+
+    # cumulative aggregates (RANGE: ties share the run-final value)
+    new_run = ~same  # run == maximal tie group; every new_part starts a run
+    run_id = jnp.cumsum(new_run.astype(_I32)) - 1
+    last_of_run = jax.ops.segment_max(iota, run_id, num_segments=n)
+
+    def ties_last(x):
+        return x[last_of_run[run_id]]
+
+    if func == "count_star":
+        return ties_last(pos_in_part + 1), jnp.ones(n, bool)
+    data, valid = arg
+    fd = _float_dtype()
+    run_count = _seg_scan(valid.astype(_I32), new_part, jnp.add)
+    run_count = ties_last(run_count)
+    out_valid = run_count > 0
+    if func == "count":
+        return run_count, jnp.ones(n, bool)
+    if func in ("sum", "avg"):
+        # integer sums accumulate in the integer dtype (exact; f32 on TPU
+        # would lose exactness past 2^24)
+        acc = data.dtype if (func == "sum" and
+                             jnp.issubdtype(data.dtype, jnp.integer)) else fd
+        w = jnp.where(valid, data.astype(acc), jnp.zeros((), acc))
+        run_sum = ties_last(_seg_scan(w, new_part, jnp.add))
+        if func == "sum":
+            return run_sum, out_valid
+        return run_sum / jnp.maximum(run_count, 1).astype(fd), out_valid
+    if func in ("min", "max"):
+        init = jnp.asarray(jnp.inf if func == "min" else -jnp.inf, fd)
+        vals = jnp.where(valid, data.astype(fd), init)
+        op = jnp.minimum if func == "min" else jnp.maximum
+        return ties_last(_seg_scan(vals, new_part, op)), out_valid
+    raise NotImplementedError(f"device window {func}")
+
+
+# ---------------------------------------------------------------------------
 # joins
 # ---------------------------------------------------------------------------
 
@@ -236,12 +322,25 @@ def build_side(gid_right: jax.Array, alive_right: jax.Array
     return sorted_gid, perm
 
 
-def probe_counts(sorted_gid: jax.Array, probe_gid: jax.Array,
-                 probe_alive: jax.Array) -> tuple[jax.Array, jax.Array]:
-    """Per-probe-row match range in the sorted build side: (start, count)."""
-    lo = jnp.searchsorted(sorted_gid, probe_gid, side="left")
-    hi = jnp.searchsorted(sorted_gid, probe_gid, side="right")
-    cnt = jnp.where(probe_alive, hi - lo, 0)
+def probe_counts_by_gid(build_gid: jax.Array, build_alive: jax.Array,
+                        probe_gid: jax.Array, probe_alive: jax.Array,
+                        gid_cap: int) -> tuple[jax.Array, jax.Array]:
+    """Per-probe-row match range in the gid-sorted build side: (start, count).
+
+    Sort-free probe (searchsorted's vmapped while-loop is pathologically slow
+    on TPU inside large programs): per-gid build counts via segment_sum, run
+    offsets via exclusive cumsum — the gid-sorted build side (build_side)
+    lays runs out in exactly that order — then a gather per probe row.
+    gid_cap: static bound on distinct gids (callers pass lcap+rcap).
+    """
+    counts = jax.ops.segment_sum(
+        build_alive.astype(_I32),
+        jnp.where(build_alive, build_gid, gid_cap), num_segments=gid_cap)
+    offsets = jnp.cumsum(counts) - counts      # exclusive prefix per gid
+    safe = jnp.clip(probe_gid, 0, gid_cap - 1)
+    in_range = probe_alive & (probe_gid >= 0) & (probe_gid < gid_cap)
+    lo = jnp.where(in_range, offsets[safe], 0)
+    cnt = jnp.where(in_range, counts[safe], 0)
     return lo.astype(_I32), cnt.astype(_I32)
 
 
@@ -251,15 +350,22 @@ def expand_join(lo: jax.Array, cnt: jax.Array, probe_alive: jax.Array,
 
     cap_out must be >= total matches (caller host-syncs the total).
     Returns (left_idx, build_pos, alive_out) each of length cap_out.
+    Run expansion is scatter-markers + cummax (no searchsorted): each probe
+    row with matches drops its row id at its output-run start; cummax
+    propagates the id across the run.
     """
     n = cnt.shape[0]
     cum = jnp.cumsum(cnt)
     total = cum[-1]
-    j = _iota(cap_out)
-    left_pos = jnp.searchsorted(cum, j, side="right").astype(_I32)
+    starts = cum - cnt
+    rows = _iota(n)
+    has = probe_alive & (cnt > 0)
+    marker = jnp.zeros(cap_out + 1, _I32).at[
+        jnp.where(has, jnp.minimum(starts, cap_out), cap_out)].max(rows)
+    left_pos = lax.cummax(marker[:cap_out])
     left_safe = jnp.minimum(left_pos, n - 1)
-    prev = jnp.where(left_safe > 0, cum[jnp.maximum(left_safe - 1, 0)], 0)
-    k = j - prev.astype(_I32)
+    j = _iota(cap_out)
+    k = j - starts[left_safe]
     build_pos = lo[left_safe] + k
     alive_out = j < total
     return left_safe, build_pos, alive_out

@@ -575,6 +575,14 @@ class Planner:
         guaranteed in the conjunct-level path)."""
         in_expr = sub.expr if isinstance(sub, A.InSubquery) else None
         negated = getattr(sub, "negated", False)
+        if negated and in_expr is not None:
+            # A mark join evaluates NOT IN with two-valued logic: a NULL
+            # outer probe or NULLs in the subquery result would yield TRUE
+            # instead of UNKNOWN. No TPC-DS template hits this; reject it
+            # rather than silently produce wrong rows.
+            raise PlanError("negated IN subquery in a nested (OR-level) "
+                            "position requires three-valued NOT IN "
+                            "semantics, which mark joins do not provide")
         sub_plan, corr_pairs, inner_keys, mixed, _inner_scope = \
             self._plan_correlated(sub.query, scope, ctes)
         if mixed:
@@ -647,6 +655,14 @@ class Planner:
         kind = "anti" if negated else "semi"
         # NOT IN (subquery) needs SQL null semantics; NOT EXISTS does not
         null_aware = negated and in_expr is not None
+        if null_aware and residual is not None:
+            # The executors test build-side NULL keys before the residual is
+            # applied, so a NOT IN whose mixed conjuncts would exclude the
+            # NULL-key build rows would still empty the result. No TPC-DS
+            # template combines these; reject instead of diverging.
+            raise PlanError("NOT IN subquery with non-equality correlated "
+                            "conjuncts (null-aware anti join with residual) "
+                            "is unsupported")
         return P.JoinNode(rel, sub_plan, kind, lkeys, rkeys, residual,
                           null_aware=null_aware,
                           out_names=list(rel.out_names),

@@ -31,6 +31,43 @@ class Session:
         self._cache: dict[str, Table] = {}
         # device-backend fallback observability, reset per sql() call
         self.last_fallbacks: list[str] = []
+        # execution-mode/timing observability for the last sql() call
+        self.last_exec_stats: dict = {}
+        # catalog generation: bumped on any (re-)registration so the device
+        # executor's scan cache and compiled plans never serve stale data
+        self._generation = 0
+        self._jax_exec = None
+        self._jax_exec_gen = -1
+
+    def _device_mesh(self):
+        """Build the SPMD mesh from config.mesh_shape (None = single device).
+
+        Multi-chip execution shards fact scans over this mesh and lets
+        GSPMD partition the compiled plan (all_to_all = shuffle, all_gather
+        = broadcast join, psum = partial-aggregate merge — the XLA-native
+        equivalents of Spark's executor shuffle, SURVEY.md §5)."""
+        if not self.config.mesh_shape:
+            return None
+        import numpy as np
+
+        import jax
+        from jax.sharding import Mesh
+        shape = tuple(self.config.mesh_shape)
+        n = int(np.prod(shape))
+        devices = np.asarray(jax.devices()[:n]).reshape(shape)
+        return Mesh(devices, self.config.mesh_axis_names[:len(shape)])
+
+    def _jax_executor(self):
+        """The session-held device executor: device-resident scan cache and
+        compiled plans persist across the whole query stream (the reference
+        keeps tables hot on the executors across the 103-query power run)."""
+        if self._jax_exec is None or self._jax_exec_gen != self._generation:
+            from .jax_backend import JaxExecutor
+            self._jax_exec = JaxExecutor(self.load_table,
+                                         jit_plans=self.config.jit_plans,
+                                         mesh=self._device_mesh())
+            self._jax_exec_gen = self._generation
+        return self._jax_exec
 
     # -- registration -------------------------------------------------------
     def register_arrow(self, name: str, table: pa.Table,
@@ -40,6 +77,7 @@ class Session:
         self._est_rows[name] = est_rows if est_rows is not None else table.num_rows
         self._loaders[name] = lambda t=table: arrow_bridge.from_arrow(t)
         self._cache.pop(name, None)
+        self._generation += 1
 
     def register_parquet(self, name: str, path: str,
                          est_rows: Optional[int] = None) -> None:
@@ -57,6 +95,7 @@ class Session:
             return arrow_bridge.from_arrow(ds.to_table())
         self._loaders[name] = load
         self._cache.pop(name, None)
+        self._generation += 1
 
     def register_csv(self, name: str, path: str, schema: pa.Schema,
                      est_rows: Optional[int] = None,
@@ -85,6 +124,7 @@ class Session:
             return arrow_bridge.from_arrow(pa.concat_tables(parts))
         self._loaders[name] = load
         self._cache.pop(name, None)
+        self._generation += 1
 
     def register_view(self, name: str, table: Table,
                       dtypes: Optional[list[str]] = None) -> None:
@@ -94,12 +134,14 @@ class Session:
         self._est_rows[name] = table.num_rows
         self._loaders[name] = lambda t=table: t
         self._cache[name] = table
+        self._generation += 1
 
     def drop(self, name: str) -> None:
         self._schemas.pop(name, None)
         self._loaders.pop(name, None)
         self._cache.pop(name, None)
         self._est_rows.pop(name, None)
+        self._generation += 1
 
     def table_names(self) -> list[str]:
         return list(self._schemas)
@@ -122,17 +164,19 @@ class Session:
         (the role CPU-Spark plays against GPU-Spark in the reference,
         nds/nds_validate.py).
         """
-        ast = parse_sql(query)
-        planner = Planner(self._catalog())
-        plan = planner.plan_query(ast)
         use_jax = (backend == "jax") if backend else self.config.use_jax
         self.last_fallbacks = []
         if use_jax:
-            from .jax_backend import JaxExecutor, to_host
-            jexec = JaxExecutor(self.load_table)
-            result = to_host(jexec.execute(plan))
+            from .jax_backend import to_host
+            jexec = self._jax_executor()
+
+            def factory():
+                return Planner(self._catalog()).plan_query(parse_sql(query))
+            result = to_host(jexec.run_query(("sql", query), factory))
             self.last_fallbacks = list(jexec.fallback_nodes)
+            self.last_exec_stats = dict(jexec.last_stats)
             return result
+        plan = Planner(self._catalog()).plan_query(parse_sql(query))
         executor = Executor(self.load_table)
         return executor.execute(plan)
 
@@ -175,9 +219,11 @@ class Session:
         plan = planner.plan_query(ast)
         use_jax = (backend == "jax") if backend else self.config.use_jax
         if use_jax:
-            from .jax_backend import JaxExecutor, to_host
-            jexec = JaxExecutor(self.load_table)
-            out = to_host(jexec.execute(plan))
+            from .jax_backend import to_host
+            jexec = self._jax_executor()
+            # one-shot statements (DML bodies, view definitions) skip the
+            # compiled-plan cache: key=None runs the recorded eager path
+            out = to_host(jexec.run_query(None, lambda: plan))
             self.last_fallbacks = list(jexec.fallback_nodes)
             return out
         return Executor(self.load_table).execute(plan)
@@ -208,6 +254,27 @@ class Session:
             self.warehouse.register_all(self)
             return
 
+        def _references_target(node) -> bool:
+            """Does the WHERE reference the target table (via a subquery)?
+            Batched evaluation would then see only a slice of the table and
+            compute the subquery wrongly — force one whole-table batch."""
+            import dataclasses as _dc
+
+            from ..sql import ast_nodes as A
+            stack = [node]
+            while stack:
+                x = stack.pop()
+                if isinstance(x, A.TableRef) and x.name == stmt.table:
+                    return True
+                if _dc.is_dataclass(x):
+                    stack.extend(getattr(x, f.name) for f in _dc.fields(x))
+                elif isinstance(x, (list, tuple)):
+                    stack.extend(x)
+            return False
+
+        batch_rows = (2 ** 62 if _references_target(stmt.where)
+                      else 4_000_000)
+
         def keep_filter(t: pa.Table):
             # per-file scoped session: the target table IS this file's rows,
             # extended with a rowid so the engine tells us which rows matched
@@ -229,7 +296,7 @@ class Session:
             deleted[ids[hit.columns[0].validity]] = True
             return pa.array(~deleted)
 
-        wt.delete_where(keep_filter)
+        wt.delete_where(keep_filter, batch_rows=batch_rows)
         self.warehouse.register_all(self)
 
     def explain(self, query: str) -> str:

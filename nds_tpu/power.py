@@ -144,13 +144,26 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
                      sub_queries: list[str] | None = None,
                      property_file: str | None = None,
                      backend: str | None = None,
+                     warmup: int = 0,
+                     strict: bool = False,
+                     profile_folder: str | None = None,
                      keep_sc: bool = False) -> list[tuple[str, int, int, int]]:
     """Run every query in the stream; returns (name, start_ms, end_ms, ms).
 
     The CSV time log layout (query name, start, end, elapsed + the
     ``Power Start/End/Test Time`` sentinel rows) matches the reference's
     (nds_power.py:281-299) so the orchestrator can scrape either.
+
+    warmup: untimed pre-runs per query before the timed run (2 reaches the
+    engine's compiled steady state: record pass + whole-plan compile).
+    strict: raise at the end if any query fell back to the host oracle
+    (the reference runs every op on the accelerator).
+    profile_folder: write a jax.profiler trace per query under this folder
+    (the Spark-UI job-group analog, reference nds_power.py:254).
     """
+    from .check import check_json_summary_folder, check_query_subset_exists
+
+    check_json_summary_folder(json_summary_folder)
     config = EngineConfig.from_property_file(property_file)
     session = Session(config)
     setup_tables(session, input_prefix, input_format)
@@ -158,23 +171,42 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
     with open(stream_path) as f:
         query_dict = gen_sql_from_stream(f.read())
     if sub_queries:
+        check_query_subset_exists(query_dict, sub_queries)
         query_dict = OrderedDict(
             (k, v) for k, v in query_dict.items()
             if k in sub_queries
             or re.sub(r"_part[12]$", "", k) in sub_queries)
 
     rows: list[tuple[str, int, int, int]] = []
+    fallback_queries: dict[str, list[str]] = {}
     power_start = int(time.time() * 1000)
     for name, sql in query_dict.items():
         report = BenchReport(config, app_name=f"NDS-TPU {name}")
+        for _ in range(warmup):
+            try:
+                run_one_query(session, sql, name, None, output_format,
+                              backend)
+            except Exception:
+                break  # the timed run reports the failure
         q_start = int(time.time() * 1000)
-        report.report_on(run_one_query, session, sql, name,
-                         output_prefix, output_format, backend)
+        if profile_folder:
+            import jax
+            os.makedirs(profile_folder, exist_ok=True)
+            with jax.profiler.trace(os.path.join(profile_folder, name)):
+                report.report_on(run_one_query, session, sql, name,
+                                 output_prefix, output_format, backend)
+        else:
+            report.report_on(run_one_query, session, sql, name,
+                             output_prefix, output_format, backend)
         for fb in session.last_fallbacks:
             report.record_task_failure(f"device fallback: {fb}")
+        if session.last_fallbacks:
+            fallback_queries[name] = list(session.last_fallbacks)
+        if session.last_exec_stats:
+            report.record_exec_stats(session.last_exec_stats)
         elapsed = report.summary["queryTimes"][-1]
         rows.append((name, q_start, q_start + elapsed, elapsed))
-        status = report.summary["queryStatus"][-1]
+        status = report.finalize_status()
         print(f"{name}: {status} in {elapsed} ms", flush=True)
         if json_summary_folder:
             report.write_summary(
@@ -190,6 +222,10 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
             w.writerow(r)
         w.writerow(["Power End Time", power_end, "", ""])
         w.writerow(["Power Test Time", "", "", power_end - power_start])
+    if strict and fallback_queries:
+        raise RuntimeError(
+            "device fallbacks in strict mode: " + "; ".join(
+                f"{q}: {fbs}" for q, fbs in fallback_queries.items()))
     return rows
 
 
@@ -207,11 +243,19 @@ def main(argv: list[str] | None = None) -> int:
                    help="comma-separated query subset, e.g. query1,query3")
     p.add_argument("--property_file", default=None)
     p.add_argument("--backend", default=None, choices=["jax", "numpy"])
+    p.add_argument("--warmup", type=int, default=0,
+                   help="untimed pre-runs per query (2 = compiled steady state)")
+    p.add_argument("--strict", action="store_true",
+                   help="fail if any query fell back to the host oracle")
+    p.add_argument("--profile_folder", default=None,
+                   help="write a jax.profiler trace per query here")
     a = p.parse_args(argv)
     sub = a.sub_queries.split(",") if a.sub_queries else None
     run_query_stream(a.input_prefix, a.query_stream_file, a.time_log,
                      a.input_format, a.output_prefix, a.output_format,
-                     a.json_summary_folder, sub, a.property_file, a.backend)
+                     a.json_summary_folder, sub, a.property_file, a.backend,
+                     warmup=a.warmup, strict=a.strict,
+                     profile_folder=a.profile_folder)
     return 0
 
 

@@ -79,6 +79,21 @@ class BenchReport:
         that did not abort the query but must surface in the status."""
         self.summary["taskFailures"].append(detail)
 
+    def record_exec_stats(self, stats: dict) -> None:
+        """Per-query device/host split (the Spark-UI job-group analog,
+        reference nds_power.py:254): execution mode (record / compile+run /
+        compiled / eager) and device milliseconds."""
+        self.summary.setdefault("execStats", []).append(stats)
+
+    def finalize_status(self) -> str:
+        """Re-derive the last status after post-run failure recording (task
+        failures land after report_on returns)."""
+        if self.summary["queryStatus"] and self.summary["taskFailures"] \
+                and self.summary["queryStatus"][-1] == "Completed":
+            self.summary["queryStatus"][-1] = "CompletedWithTaskFailures"
+        return self.summary["queryStatus"][-1] if \
+            self.summary["queryStatus"] else "Failed"
+
     def write_summary(self, query_name: str, prefix: str = "") -> str | None:
         if not prefix:
             return None

@@ -72,7 +72,12 @@ def _eval_param(expr: str, r: int):
         return str(1 + r % 12)
     m = re.match(r"^ziplist\((\d+)\)$", expr)
     if m:
-        # k distinct 5-digit zips, quoted + comma-joined (q8-style IN list)
+        # k distinct 5-digit zips, quoted + comma-joined (q8-style IN list).
+        # Uniform over 0..99999 deliberately: the native generator draws
+        # *_zip as `r % 100000` over a mixed hash (native/datagen/gen.cpp,
+        # `ends_with(n, "_zip")` branch), so uniform sampling here matches
+        # the data's actual zip distribution (dsqgen samples from dsdgen's
+        # skewed distribution for the same reason).
         k = int(m.group(1))
         rr, seen = r, []
         while len(seen) < k:

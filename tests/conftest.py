@@ -18,3 +18,9 @@ import jax
 # force the platform through the config system too.
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+# Persist XLA compilations across test sessions: the engine jit-compiles its
+# kernels per shape bucket, and tiny-SF tests revisit the same buckets.
+from nds_tpu.config import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()

@@ -1,0 +1,114 @@
+"""Independent correctness oracle: SQLite (stdlib) as a second SQL engine.
+
+The numpy-vs-jax differential suite shares one parser/planner, so it cannot
+catch planner bugs. This oracle re-runs the same instantiated templates on
+SQLite (its own parser, planner and executor — the independent-engine role
+CPU-Spark plays in the reference, nds/nds_validate.py:48-114) over the same
+generated data and compares rows under the validator's epsilon policy.
+
+Dialect translation (our templates use exactly these non-SQLite forms —
+verified over nds_tpu/templates/*.tpl):
+- ``CAST('lit' AS DATE)``      -> ``'lit'``     (dates are ISO TEXT)
+- ``expr + INTERVAL N DAYS``   -> ``date(expr, '+N days')``
+- ``CAST(x AS DOUBLE)``        -> ``CAST(x AS REAL)``
+- ``a / b``                    -> ``a * 1.0 / b``  (Spark divides in double;
+  SQLite would truncate int/int)
+
+Templates using ROLLUP/GROUPING are skipped: SQLite has no grouping sets.
+"""
+from __future__ import annotations
+
+import datetime
+import os
+import re
+import sqlite3
+
+from nds_tpu.schema import get_schemas
+
+# SQLite column affinity per engine dtype
+_AFFINITY = {"int": "INTEGER", "float": "REAL", "bool": "INTEGER",
+             "date": "TEXT", "str": "TEXT"}
+
+_CAST_DATE = re.compile(r"CAST\s*\(\s*('([^']*)')\s+AS\s+DATE\s*\)",
+                        re.IGNORECASE)
+_CAST_DOUBLE = re.compile(r"AS\s+DOUBLE\s*\)", re.IGNORECASE)
+_INTERVAL = re.compile(
+    r"('[^']*'|[A-Za-z_][A-Za-z0-9_.]*)\s*([+-])\s*INTERVAL\s+(\d+)\s+DAYS?",
+    re.IGNORECASE)
+_DIV = re.compile(r"(?<![*/])/(?![*/])")
+
+
+def to_sqlite_sql(sql: str) -> str:
+    sql = _CAST_DATE.sub(lambda m: m.group(1), sql)
+    sql = _CAST_DOUBLE.sub("AS REAL)", sql)
+    sql = _INTERVAL.sub(
+        lambda m: f"date({m.group(1)}, '{m.group(2)}{m.group(3)} days')",
+        sql)
+    # integer division differs (Spark: double, SQLite: truncating int)
+    sql = _DIV.sub(" * 1.0 / ", sql)
+    return sql
+
+
+def load_database(data_dir: str, use_decimal: bool = False) -> sqlite3.Connection:
+    """Load the generated pipe-delimited CSVs into an in-memory SQLite DB."""
+    conn = sqlite3.connect(":memory:")
+    for name, schema in get_schemas(use_decimal).items():
+        tdir = os.path.join(data_dir, name)
+        if not os.path.isdir(tdir):
+            continue
+        from nds_tpu.engine.arrow_bridge import engine_dtype
+        fields = [(f.name, engine_dtype(f.type))
+                  for f in schema.arrow_schema(use_decimal=False)]
+        cols = ", ".join(f'"{n}" {_AFFINITY[d]}' for n, d in fields)
+        conn.execute(f'CREATE TABLE "{name}" ({cols})')
+        placeholders = ", ".join("?" * len(fields))
+        rows = []
+        for fname in sorted(os.listdir(tdir)):
+            with open(os.path.join(tdir, fname)) as f:
+                for line in f:
+                    parts = line.rstrip("\n").split("|")
+                    if len(parts) < len(fields):
+                        continue
+                    rows.append(tuple(
+                        None if p == "" else _convert(p, d)
+                        for p, (_n, d) in zip(parts, fields)))
+        if rows:
+            conn.executemany(
+                f'INSERT INTO "{name}" VALUES ({placeholders})', rows)
+        # join keys: without indexes SQLite nested-loops the star joins
+        for n, _d in fields:
+            if n.endswith("_sk"):
+                conn.execute(f'CREATE INDEX IF NOT EXISTS '
+                             f'"ix_{name}_{n}" ON "{name}"("{n}")')
+    conn.commit()
+    conn.execute("ANALYZE")
+    return conn
+
+
+def _convert(text: str, dtype: str):
+    if dtype == "int":
+        return int(text)
+    if dtype == "float":
+        return float(text)
+    if dtype == "bool":
+        return 1 if text.lower() in ("true", "1", "y") else 0
+    return text  # str and date (ISO text)
+
+
+def normalize_rows(rows) -> list[tuple]:
+    """Canonical form for comparison: dates to ISO text, Decimal to float."""
+    out = []
+    for row in rows:
+        out.append(tuple(
+            v.isoformat() if isinstance(v, (datetime.date, datetime.datetime))
+            else float(v) if type(v).__name__ == "Decimal"
+            else v
+            for v in row))
+    return out
+
+
+def sort_rows(rows: list[tuple]) -> list[tuple]:
+    def key(row):
+        return tuple((v is None, "" if v is None else str(v))
+                     for v in row if not isinstance(v, float))
+    return sorted(rows, key=key)

@@ -1,0 +1,96 @@
+"""Preflight checks + multi-host datagen fanout + report finalization."""
+import json
+import os
+import stat
+import subprocess
+
+import pytest
+
+from nds_tpu import check
+from nds_tpu.report import BenchReport
+
+
+def test_version_gate():
+    check.check_version((3, 0))
+    with pytest.raises(RuntimeError):
+        check.check_version((99, 0))
+
+
+def test_dir_size(tmp_path):
+    (tmp_path / "a").write_bytes(b"x" * 100)
+    sub = tmp_path / "sub"
+    sub.mkdir()
+    (sub / "b").write_bytes(b"y" * 50)
+    assert check.get_dir_size(str(tmp_path)) == 150
+
+
+def test_json_summary_folder(tmp_path):
+    check.check_json_summary_folder(None)
+    check.check_json_summary_folder(str(tmp_path / "new"))  # missing: fine
+    full = tmp_path / "full"
+    full.mkdir()
+    (full / "old.json").write_text("{}")
+    with pytest.raises(RuntimeError):
+        check.check_json_summary_folder(str(full))
+
+
+def test_query_subset_exists():
+    qd = {"query1": "", "query14_part1": "", "query14_part2": ""}
+    assert check.check_query_subset_exists(qd, ["query1", "query14"])
+    with pytest.raises(RuntimeError):
+        check.check_query_subset_exists(qd, ["query99"])
+
+
+def test_generate_data_hosts_fanout(tmp_path, monkeypatch):
+    """ssh fanout (the reference's Hadoop MR role, GenTable.java): exercised
+    with a stub `ssh` that runs the remote command locally."""
+    from nds_tpu.datagen import generate_data_hosts
+
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    log = tmp_path / "ssh.log"
+    ssh = bindir / "ssh"
+    ssh.write_text(
+        "#!/bin/sh\n"
+        f"echo \"$1\" >> {log}\n"
+        "shift\n"
+        "exec sh -c \"$*\"\n")
+    ssh.chmod(ssh.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("PATH", f"{bindir}:{os.environ['PATH']}")
+
+    data_dir = tmp_path / "out"
+    generate_data_hosts(str(data_dir), scale=0.001, parallel=2,
+                        hosts=["hostA", "hostB"])
+    hosts_used = log.read_text().split()
+    assert sorted(hosts_used) == ["hostA", "hostB"]
+    # both chunk ranges produced output for a chunked table
+    assert (data_dir / "store_sales").exists()
+    assert len(os.listdir(data_dir / "store_sales")) >= 1
+    # every source table non-empty (merge/verify behavior)
+    assert (data_dir / "date_dim").exists()
+
+
+def test_generate_data_hosts_failure(tmp_path, monkeypatch):
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    ssh = bindir / "ssh"
+    ssh.write_text("#!/bin/sh\nexit 7\n")
+    ssh.chmod(ssh.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("PATH", f"{bindir}:{os.environ['PATH']}")
+
+    from nds_tpu.datagen import generate_data_hosts
+    with pytest.raises(RuntimeError, match="host generation failed"):
+        generate_data_hosts(str(tmp_path / "o"), 0.001, 2, ["h1"])
+
+
+def test_report_finalize_and_stats(tmp_path):
+    r = BenchReport({}, app_name="t")
+    r.report_on(lambda: 42)
+    assert r.summary["queryStatus"][-1] == "Completed"
+    r.record_task_failure("device fallback: WindowNode")
+    assert r.finalize_status() == "CompletedWithTaskFailures"
+    r.record_exec_stats({"mode": "compiled", "device_ms": 1.5})
+    path = r.write_summary("query1", prefix=str(tmp_path / "power"))
+    data = json.load(open(path))
+    assert data["queryStatus"] == ["CompletedWithTaskFailures"]
+    assert data["execStats"][0]["mode"] == "compiled"

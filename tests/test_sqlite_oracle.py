@@ -1,0 +1,63 @@
+"""Template differential vs SQLite — an INDEPENDENT engine (own parser,
+planner, executor), catching shared-frontend bugs the numpy/jax comparison
+cannot (SURVEY.md §4; reference independent-oracle role:
+nds/nds_validate.py:48-114)."""
+import sqlite3
+
+import pytest
+
+from nds_tpu import datagen, streams, validate
+from nds_tpu.engine import Session
+from nds_tpu.engine import arrow_bridge
+from nds_tpu.power import setup_tables
+
+from sqlite_oracle import (load_database, normalize_rows, sort_rows,
+                           to_sqlite_sql)
+
+# SQLite has no grouping sets: skip the ROLLUP/GROUPING templates
+ROLLUP_TEMPLATES = {5, 14, 18, 22, 27, 36, 67, 70, 77, 80, 86}
+
+
+def sqlite_supported_templates():
+    return [n for n in streams.available_templates()
+            if n not in ROLLUP_TEMPLATES]
+
+
+@pytest.fixture(scope="module")
+def env(tmp_path_factory):
+    data = str(tmp_path_factory.mktemp("sqlite_oracle") / "d")
+    datagen.generate_data_local(data, 0.001, parallel=2, overwrite=True)
+    session = Session()
+    setup_tables(session, data, "csv")
+    conn = load_database(data)
+    return session, conn
+
+
+def _engine_rows(table):
+    at = arrow_bridge.to_arrow(table)
+    cols = [c.to_pylist() for c in at.columns]
+    return normalize_rows(list(zip(*cols)) if cols else [])
+
+
+@pytest.mark.parametrize("number", sqlite_supported_templates())
+def test_template_vs_sqlite(env, number):
+    session, conn = env
+    sql = streams.instantiate(number, stream=0, rngseed=31415)
+    parts = (streams.split_special_query(f"query{number}", sql)
+             if number in streams.SPECIAL_TEMPLATES
+             else [(f"query{number}", sql)])
+    for name, part_sql in parts:
+        lite_sql = to_sqlite_sql(part_sql)
+        try:
+            expected = conn.execute(lite_sql).fetchall()
+        except sqlite3.OperationalError as e:
+            pytest.skip(f"sqlite cannot run {name}: {e}")
+        actual = session.sql(part_sql, backend="numpy")
+        rows_e = sort_rows(normalize_rows(expected))
+        rows_a = sort_rows(_engine_rows(actual))
+        assert len(rows_e) == len(rows_a), \
+            f"{name}: sqlite {len(rows_e)} rows vs engine {len(rows_a)}"
+        names = list(actual.names)
+        for re_, ra_ in zip(rows_e, rows_a):
+            assert validate.row_equal(re_, ra_, name, names), \
+                f"{name}: sqlite {re_} != engine {ra_}"

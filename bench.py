@@ -34,9 +34,12 @@ REPO = os.path.dirname(os.path.abspath(__file__))
 BENCH_DIR = os.environ.get("NDS_TPU_BENCH_DIR",
                            os.path.join(REPO, ".bench_data"))
 SCALE = os.environ.get("NDS_TPU_BENCH_SF", "1")
+# default subset: a spread of plan shapes (correlated-subquery CTE, star
+# join+group, multi-dim join, scalar-subquery battery, semi/anti) whose
+# record+compile cost fits the driver's bench budget
 QUERIES = os.environ.get(
     "NDS_TPU_BENCH_QUERIES",
-    "query1,query2,query3,query4,query5").split(",")
+    "query1,query3,query7,query9,query10").split(",")
 RNGSEED = 778  # fixed: cross-round comparability
 TIMED_RUNS = 3
 
@@ -110,7 +113,7 @@ def main() -> None:
 
     total_jax = sum(jax_ms.values())
     total_np = sum(np_ms.values())
-    qtag = f"q{units[0].replace('query', '')}-q{units[-1].replace('query', '')}"
+    qtag = "+".join(u.replace("query", "q") for u in units)
     print(json.dumps({
         "metric": f"nds_power_{qtag}_sf{SCALE}_ms",
         "value": round(total_jax, 1),

@@ -28,6 +28,10 @@ class EngineConfig:
     mesh_axis_names: tuple[str, ...] = ("shards",)
     # rows per morsel when streaming host->device
     chunk_rows: int = 1 << 20
+    # out-of-core execution: stream aggregates over one large scan in
+    # chunk_rows morsels (bounded peak memory; SURVEY.md §5 long-context
+    # analog). Eligible plans only; others run in-core.
+    out_of_core: bool = False
     # run jitted per-op kernels (True) or pure-numpy fallback (False, debug only)
     use_jax: bool = True
     # compile whole plans to one XLA program on re-execution (record/replay);

@@ -125,13 +125,22 @@ def generate_data_local(data_dir: str, scale: float, parallel: int,
 
 
 def generate_data_hosts(data_dir: str, scale: float, parallel: int,
-                        hosts: list[str], update: int = 0) -> None:
+                        hosts: list[str], update: int = 0,
+                        overwrite: bool = False) -> None:
     """Multi-host fanout: assign chunk ranges to hosts via ssh.
 
     The TPU-native replacement for the reference's Hadoop MR wrapper
     (GenTable.java): no cluster framework, one ssh per host with a chunk
-    range; hosts share a filesystem or sync afterwards.
+    range; hosts share a filesystem or sync afterwards. The coordinator
+    prepares the shared dir ONCE (range runs never wipe it — a stale dir
+    mixed with new chunks would duplicate rows downstream).
     """
+    if os.path.exists(data_dir) and os.listdir(data_dir):
+        if not overwrite:
+            raise FileExistsError(
+                f"{data_dir} is not empty; pass overwrite to replace")
+        shutil.rmtree(data_dir, ignore_errors=True)
+    os.makedirs(data_dir, exist_ok=True)
     n = len(hosts)
     procs = []
     for i, host in enumerate(hosts):
@@ -177,7 +186,8 @@ def main(argv: list[str] | None = None) -> int:
         hosts = [h for h in a.hosts.split(",") if h]
         if not hosts:
             p.error("hosts mode requires --hosts")
-        generate_data_hosts(a.data_dir, a.scale, a.parallel, hosts, a.update)
+        generate_data_hosts(a.data_dir, a.scale, a.parallel, hosts, a.update,
+                            overwrite=a.overwrite)
     return 0
 
 

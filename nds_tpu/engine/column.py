@@ -129,6 +129,10 @@ class Table:
     def take(self, indices: np.ndarray) -> "Table":
         return Table(self.names, [c.take(indices) for c in self.columns])
 
+    def select(self, names: list[str]) -> "Table":
+        idx = {n: i for i, n in enumerate(self.names)}
+        return Table(list(names), [self.columns[idx[n]] for n in names])
+
     def head(self, n: int) -> "Table":
         if self.num_rows <= n:
             return self

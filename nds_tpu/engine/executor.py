@@ -27,6 +27,14 @@ class Executor:
         self._memo: dict[int, Table] = {}
         self._trace = trace
 
+    def _load_columns(self, table: str, columns) -> Table:
+        """Column-pruned load when the loader supports projection (scan
+        pruning; plain callables keep working for tests/fallback nodes)."""
+        try:
+            return self._load_table(table, tuple(columns))
+        except TypeError:
+            return self._load_table(table)
+
     def execute(self, node: PlanNode) -> Table:
         key = id(node)
         if key in self._memo:
@@ -53,7 +61,7 @@ class Executor:
         if isinstance(node, MaterializedNode):
             return node.table
         if isinstance(node, ScanNode):
-            t = self._load_table(node.table)
+            t = self._load_columns(node.table, node.columns)
             index = {n: i for i, n in enumerate(t.names)}
             cols = [t.columns[index[c]] for c in node.columns]
             return Table(list(node.out_names), cols)

@@ -175,11 +175,19 @@ def to_host(dt: DTable, count: Optional[int] = None) -> Table:
 def _flatten_compound(c: DCol) -> DCol:
     """Materialize a lazy-concat compound string column into a real dictionary.
 
-    String appends run once per *distinct* part-code tuple, not per row:
-    rows are first deduplicated over their stacked part codes.
+    Concrete path: string appends run once per *distinct* part-code tuple
+    (rows deduplicated over stacked codes). Traced path (inside a compiled
+    plan): the output dictionary must be data-INdependent, so it becomes the
+    mixed-radix cross product of the part dictionaries (+ an empty-string
+    slot per part for null/invalid codes) and row codes are computed on
+    device — sized like the id-column dictionary for the typical
+    literal||column||literal concat.
     """
     if c.parts is None:
         return c
+    if any(isinstance(p.data, jax.core.Tracer) for p in c.parts) or \
+            isinstance(c.valid, jax.core.Tracer):
+        return _flatten_compound_traced(c)
     code_mat = np.stack([np.where(np.asarray(p.valid), np.asarray(p.data), -1)
                          for p in c.parts], axis=1)
     uniq_rows, inverse = np.unique(code_mat, axis=0, return_inverse=True)
@@ -196,14 +204,63 @@ def _flatten_compound(c: DCol) -> DCol:
     return DCol("str", jnp.asarray(codes), c.valid, uniq.astype(object))
 
 
-def string_rank_lut(dictionary: Optional[np.ndarray]) -> np.ndarray:
-    """Host LUT: dictionary code -> lexicographic rank (for device sort/compare)."""
+def _flatten_compound_traced(c: DCol) -> DCol:
+    """Trace-safe compound flatten: cross-product dictionary, device codes."""
+    dicts = []
+    for p in c.parts:
+        d = p.dictionary if p.dictionary is not None \
+            else np.empty(0, dtype=object)
+        # slot len(d) holds "" for null/invalid part codes
+        dicts.append(np.concatenate([d.astype(object),
+                                     np.asarray([""], dtype=object)]))
+    total = 1
+    for d in dicts:
+        total *= len(d)
+    if total > (1 << 20):
+        raise NotImplementedError(
+            f"compound string cross dictionary too large ({total})")
+    # mixed-radix joined dictionary, last part fastest-varying
+    joined = np.asarray([""], dtype=object)
+    for d in dicts:
+        joined = np.asarray([a + b for a in joined for b in d], dtype=object)
+    code = jnp.zeros(c.parts[0].data.shape, jnp.int32)
+    for p, d in zip(c.parts, dicts):
+        n = len(d)
+        eff = jnp.where(p.valid & (p.data >= 0),
+                        jnp.clip(p.data, 0, n - 2 if n > 1 else 0),
+                        n - 1).astype(jnp.int32)
+        code = code * n + eff
+    return DCol("str", code, c.valid, joined)
+
+
+def string_rank_maps(dictionary: Optional[np.ndarray]
+                     ) -> tuple[np.ndarray, np.ndarray]:
+    """Host LUTs for a string dictionary: (code -> dense lexicographic rank,
+    dense rank -> representative code).
+
+    Equal strings get EQUAL ranks (dictionaries from compound cross products
+    may contain duplicates; distinct ranks would break equality compares),
+    so mapping an aggregated rank back to a code must go through the
+    rank->code table — NOT through argsort position.
+    """
     if dictionary is None or len(dictionary) == 0:
-        return np.zeros(1, dtype=np.int32)
-    order = np.argsort(dictionary.astype(str), kind="stable")
-    ranks = np.empty(len(dictionary), dtype=np.int32)
-    ranks[order] = np.arange(len(dictionary), dtype=np.int32)
-    return ranks
+        return np.zeros(1, dtype=np.int32), np.zeros(1, dtype=np.int32)
+    vals = dictionary.astype(str)
+    order = np.argsort(vals, kind="stable")
+    svals = vals[order]
+    dense = np.cumsum(np.concatenate(
+        [[0], (svals[1:] != svals[:-1]).astype(np.int32)])).astype(np.int32)
+    ranks = np.empty(len(vals), dtype=np.int32)
+    ranks[order] = dense
+    rank_to_code = np.zeros(int(dense[-1]) + 1, dtype=np.int32)
+    # reversed assignment => the FIRST occurrence in sorted order wins
+    rank_to_code[dense[::-1]] = order[::-1].astype(np.int32)
+    return ranks, rank_to_code
+
+
+def string_rank_lut(dictionary: Optional[np.ndarray]) -> np.ndarray:
+    """Host LUT: dictionary code -> dense lexicographic rank."""
+    return string_rank_maps(dictionary)[0]
 
 
 def rank_key(c: DCol) -> jax.Array:

@@ -194,11 +194,16 @@ class JaxExecutor:
         if ent is not None:
             if ent["cq"] is not None:                  # steady state
                 try:
-                    return ent["cq"].run(self._scans_for(ent),
-                                         stats=self.last_stats)
+                    return self._run_compiled(ent["cq"], ent)
                 except ReplayMismatch:
                     self._plans.pop(key, None)
                     ent = None
+                except jax.errors.JaxRuntimeError as e:
+                    # transient infra failure (e.g. remote compile service
+                    # hiccup): serve this call eagerly, keep the program
+                    self.last_stats.update(mode="eager",
+                                           transient=f"{e}"[:200])
+                    return self._eager(ent["plan"])
             elif ent["nojit"]:
                 self.last_stats["mode"] = "eager"
                 return self._eager(ent["plan"])
@@ -206,7 +211,7 @@ class JaxExecutor:
                 cq = CompiledQuery(ent["plan"], ent["decisions"],
                                    ent["scan_keys"])
                 try:
-                    out = cq.run(self._scans_for(ent), stats=self.last_stats)
+                    out = self._run_compiled(cq, ent)
                     ent["cq"] = cq
                     return out
                 except _NOJIT_ERRORS as e:
@@ -218,22 +223,48 @@ class JaxExecutor:
                 except ReplayMismatch:
                     self._plans.pop(key, None)
                     ent = None
+                except jax.errors.JaxRuntimeError as e:
+                    # transient: don't mark nojit — the next execution
+                    # retries compilation
+                    self.last_stats.update(mode="eager",
+                                           transient=f"{e}"[:200])
+                    return self._eager(ent["plan"])
         # first sighting (or invalidated): eager run, recording the schedule
         plan = plan_factory()
+        self.last_stats["mode"] = "record"
+        out, decisions, scan_keys = self.record_plan(plan)
+        if key is not None and self._jit_plans:
+            self._plans[key] = {
+                "plan": plan, "decisions": decisions,
+                "scan_keys": scan_keys,
+                "cq": None, "nojit": bool(self.fallback_nodes)}
+        return out
+
+    def record_plan(self, plan: PlanNode):
+        """Eager run that records the capacity schedule; returns
+        (result, decisions, scan_keys)."""
         rec = _Recorder("record")
         self._rec = rec
         self._touched_scans = set()
-        self.last_stats["mode"] = "record"
         try:
             out = self._eager(plan)
         finally:
             self._rec = None
-        if key is not None and self._jit_plans:
-            self._plans[key] = {
-                "plan": plan, "decisions": rec.decisions,
-                "scan_keys": tuple(sorted(self._touched_scans)),
-                "cq": None, "nojit": bool(self.fallback_nodes)}
-        return out
+        return out, rec.decisions, tuple(sorted(self._touched_scans))
+
+    def _load_columns(self, table: str, columns) -> Table:
+        try:
+            return self._load_table(table, tuple(columns))
+        except TypeError:
+            return self._load_table(table)
+
+    def _run_compiled(self, cq: CompiledQuery, ent) -> DTable:
+        """Run a compiled plan, retrying once on transient runtime errors
+        (the remote compile/execute service can drop a connection)."""
+        try:
+            return cq.run(self._scans_for(ent), stats=self.last_stats)
+        except jax.errors.JaxRuntimeError:
+            return cq.run(self._scans_for(ent), stats=self.last_stats)
 
     def _eager(self, plan: PlanNode) -> DTable:
         self._memo = {}
@@ -251,7 +282,7 @@ class JaxExecutor:
                 if k not in self._scan_meta:
                     raise ReplayMismatch(f"scan meta miss: {k}")
                 table, columns, names = self._scan_meta[k]
-                t = self._load_table(table)
+                t = self._load_columns(table, columns)
                 index = {n: i for i, n in enumerate(t.names)}
                 cols = [t.columns[index[c]] for c in columns]
                 host = Table(list(names), cols)
@@ -447,7 +478,7 @@ class JaxExecutor:
         if cache_key not in cache:
             if self._replay:
                 raise NotJittable(f"scan {cache_key!r} missing under trace")
-            t = self._load_table(node.table)
+            t = self._load_columns(node.table, node.columns)
             index = {n: i for i, n in enumerate(t.names)}
             cols = [t.columns[index[c]] for c in node.columns]
             cache[cache_key] = to_device(Table(list(node.out_names), cols),
@@ -616,16 +647,12 @@ class JaxExecutor:
             return DCol("int", vals.astype(phys_dtype("int")), valid)
         if spec.func not in ("min", "max"):
             raise NotImplementedError(f"device {spec.func} over strings")
-        d = arg_col.dictionary if arg_col.dictionary is not None \
-            else np.empty(0, dtype=object)
-        ranks = string_rank_lut(d)
-        order = np.argsort(d.astype(str), kind="stable") if len(d) \
-            else np.zeros(1, dtype=np.int64)
+        from .device import string_rank_maps
+        ranks, rank_to_code = string_rank_maps(arg_col.dictionary)
         rank_data = jexprs._lut_gather(arg_col.data, ranks)
         vals, valid = kernels.agg_apply(gid, alive, spec.func,
                                         (rank_data, arg_col.valid), cap_out)
-        codes = jexprs._lut_gather(vals.astype(_I32),
-                                   order.astype(np.int32))
+        codes = jexprs._lut_gather(vals.astype(_I32), rank_to_code)
         return DCol("str", codes, valid, arg_col.dictionary)
 
     # -- joins ---------------------------------------------------------------

@@ -179,11 +179,6 @@ def agg_apply(gid: jax.Array, alive: jax.Array, func: str, arg,
     raise NotImplementedError(f"device agg {func}")
 
 
-def aggregate(gid: jax.Array, alive: jax.Array, specs: list[AggSpec],
-              args: list, cap_out: int) -> list[tuple[jax.Array, jax.Array]]:
-    """Multi-spec wrapper over agg_apply (kept for call-site compatibility)."""
-    return [agg_apply(gid, alive, spec.func, arg, cap_out)
-            for spec, arg in zip(specs, args)]
 
 
 def _float_dtype():
@@ -302,10 +297,14 @@ def window_ordered_core(sgid: jax.Array, tie_data: list[jax.Array],
             return run_sum, out_valid
         return run_sum / jnp.maximum(run_count, 1).astype(fd), out_valid
     if func in ("min", "max"):
-        init = jnp.asarray(jnp.inf if func == "min" else -jnp.inf, fd)
-        vals = jnp.where(valid, data.astype(fd), init)
+        # accumulate in the NATIVE dtype: int keys past 2^24 would round
+        # in f32 (TPU x32), and f32 round-trips would corrupt exact mins
+        ext = _extreme(data.dtype, func)
+        vals = jnp.where(valid, data, ext)
         op = jnp.minimum if func == "min" else jnp.maximum
-        return ties_last(_seg_scan(vals, new_part, op)), out_valid
+        out = ties_last(_seg_scan(vals, new_part, op))
+        out = jnp.where(out_valid, out, jnp.zeros((), data.dtype))
+        return out, out_valid
     raise NotImplementedError(f"device window {func}")
 
 

@@ -29,6 +29,9 @@ class Session:
         self._schemas: dict[str, tuple[list[str], list[str]]] = {}
         self._est_rows: dict[str, int] = {}
         self._cache: dict[str, Table] = {}
+        # optional streaming readers for out-of-core scans: name ->
+        # fn(columns) yielding arrow tables/batches
+        self._batch_sources: dict = {}
         # device-backend fallback observability, reset per sql() call
         self.last_fallbacks: list[str] = []
         # execution-mode/timing observability for the last sql() call
@@ -75,8 +78,13 @@ class Session:
         names, dtypes = arrow_bridge.engine_schema(table.schema)
         self._schemas[name] = (names, dtypes)
         self._est_rows[name] = est_rows if est_rows is not None else table.num_rows
-        self._loaders[name] = lambda t=table: arrow_bridge.from_arrow(t)
-        self._cache.pop(name, None)
+        self._loaders[name] = lambda columns=None, t=table: \
+            arrow_bridge.from_arrow(t.select(list(columns)) if columns else t)
+
+        def batches(columns, t=table):
+            yield t.select(list(columns)) if columns else t
+        self._batch_sources[name] = batches
+        self._drop_cached(name)
         self._generation += 1
 
     def register_parquet(self, name: str, path: str,
@@ -91,10 +99,16 @@ class Session:
             est_rows = dataset.count_rows()
         self._est_rows[name] = est_rows
 
-        def load(ds=dataset):
-            return arrow_bridge.from_arrow(ds.to_table())
+        def load(columns=None, ds=dataset):
+            cols = list(columns) if columns is not None else None
+            return arrow_bridge.from_arrow(ds.to_table(columns=cols))
         self._loaders[name] = load
-        self._cache.pop(name, None)
+
+        def batches(columns, ds=dataset):
+            cols = list(columns) if columns is not None else None
+            yield from ds.to_batches(columns=cols)
+        self._batch_sources[name] = batches
+        self._drop_cached(name)
         self._generation += 1
 
     def register_csv(self, name: str, path: str, schema: pa.Schema,
@@ -111,10 +125,11 @@ class Session:
         self._schemas[name] = (names, dtypes)
         self._est_rows[name] = est_rows if est_rows is not None else 10000
 
-        def load(files=tuple(files), schema=schema):
+        def load(columns=None, files=tuple(files), schema=schema):
             convert = pa_csv.ConvertOptions(
                 column_types={f.name: f.type for f in schema},
-                null_values=[""], strings_can_be_null=True)
+                null_values=[""], strings_can_be_null=True,
+                include_columns=list(columns) if columns else None)
             read = pa_csv.ReadOptions(column_names=[f.name for f in schema])
             parse = pa_csv.ParseOptions(delimiter=delimiter)
             parts = [pa_csv.read_csv(f, read_options=read,
@@ -123,7 +138,21 @@ class Session:
                      for f in files if os.path.getsize(f) > 0]
             return arrow_bridge.from_arrow(pa.concat_tables(parts))
         self._loaders[name] = load
-        self._cache.pop(name, None)
+
+        def batches(columns, files=tuple(files), schema=schema):
+            convert = pa_csv.ConvertOptions(
+                column_types={f.name: f.type for f in schema},
+                null_values=[""], strings_can_be_null=True,
+                include_columns=list(columns) if columns else None)
+            read = pa_csv.ReadOptions(column_names=[f.name for f in schema])
+            parse = pa_csv.ParseOptions(delimiter=delimiter)
+            for f in files:
+                if os.path.getsize(f) > 0:
+                    yield pa_csv.read_csv(f, read_options=read,
+                                          parse_options=parse,
+                                          convert_options=convert)
+        self._batch_sources[name] = batches
+        self._drop_cached(name)
         self._generation += 1
 
     def register_view(self, name: str, table: Table,
@@ -132,24 +161,77 @@ class Session:
         dts = dtypes or [c.dtype for c in table.columns]
         self._schemas[name] = (list(table.names), dts)
         self._est_rows[name] = table.num_rows
-        self._loaders[name] = lambda t=table: t
-        self._cache[name] = table
+        self._loaders[name] = lambda columns=None, t=table: \
+            t if columns is None else t.select(list(columns))
+        self._drop_cached(name)
+        self._cache[(name, None)] = table
         self._generation += 1
 
     def drop(self, name: str) -> None:
         self._schemas.pop(name, None)
         self._loaders.pop(name, None)
-        self._cache.pop(name, None)
+        self._batch_sources.pop(name, None)
+        self._drop_cached(name)
         self._est_rows.pop(name, None)
         self._generation += 1
 
     def table_names(self) -> list[str]:
         return list(self._schemas)
 
-    def load_table(self, name: str) -> Table:
-        if name not in self._cache:
-            self._cache[name] = self._loaders[name]()
-        return self._cache[name]
+    def _drop_cached(self, name: str) -> None:
+        for k in [k for k in self._cache if k[0] == name]:
+            del self._cache[k]
+
+    def iter_morsels(self, name: str, columns: list[str], rows: int):
+        """Yield host Tables of at most `rows` rows each, WITHOUT
+        materializing the whole table (out-of-core scans). Parquet datasets
+        stream record batches; arrow tables slice zero-copy; CSV falls back
+        to per-file reads."""
+        import pyarrow as pa
+
+        def emit(batches):
+            """Re-chunk a stream of arrow tables into `rows`-sized morsels."""
+            pending: list[pa.Table] = []
+            count = 0
+            for b in batches:
+                t = pa.Table.from_batches([b]) if isinstance(
+                    b, pa.RecordBatch) else b
+                while t.num_rows:
+                    take = min(rows - count, t.num_rows)
+                    pending.append(t.slice(0, take))
+                    t = t.slice(take)
+                    count += take
+                    if count == rows:
+                        yield pa.concat_tables(pending)
+                        pending, count = [], 0
+            if pending:
+                yield pa.concat_tables(pending)
+
+        src = self._batch_sources.get(name)
+        if src is not None:
+            batches = src(columns)
+        else:  # fallback: full load, sliced (correct, not memory-bounded)
+            batches = [arrow_bridge.to_arrow(self.load_table(name, columns))]
+        for part in emit(batches):
+            yield arrow_bridge.from_arrow(part)
+
+    def load_table(self, name: str, columns=None) -> Table:
+        """Load a table, optionally projected to `columns` (scan pruning:
+        fact tables carry ~23 columns but a query touches a handful — the
+        reference gets this from parquet column projection in Spark scans).
+        Cached per projection; a cached full table serves any subset."""
+        key = (name, tuple(columns) if columns is not None else None)
+        if key in self._cache:
+            return self._cache[key]
+        if columns is not None and (name, None) in self._cache:
+            full = self._cache[(name, None)]
+            idx = {n: i for i, n in enumerate(full.names)}
+            sub = Table(list(columns),
+                        [full.columns[idx[c]] for c in columns])
+            self._cache[key] = sub
+            return sub
+        self._cache[key] = self._loaders[name](columns)
+        return self._cache[key]
 
     # -- query --------------------------------------------------------------
     def _catalog(self) -> Catalog:
@@ -168,6 +250,10 @@ class Session:
         self.last_fallbacks = []
         if use_jax:
             from .jax_backend import to_host
+            if self.config.out_of_core:
+                result = self._sql_streaming(query)
+                if result is not None:
+                    return result
             jexec = self._jax_executor()
 
             def factory():
@@ -179,6 +265,87 @@ class Session:
         plan = Planner(self._catalog()).plan_query(parse_sql(query))
         executor = Executor(self.load_table)
         return executor.execute(plan)
+
+    def _sql_streaming(self, query: str):
+        """Out-of-core execution for eligible aggregate plans: the large
+        scan streams through the device in chunk_rows morsels sharing ONE
+        compiled program; partial aggregates merge on host (engine/streaming
+        module; reference analog: maxPartitionBytes chunked scans +
+        shuffle spill, power_run_gpu.template). Returns None if the plan is
+        not streamable."""
+        from . import streaming
+        from .jax_backend import JaxExecutor, to_host
+        from .jax_backend.device import bucket, to_device
+        from .jax_backend.executor import CompiledQuery, ReplayMismatch
+
+        plan = Planner(self._catalog()).plan_query(parse_sql(query))
+        path, agg = streaming._path_to_aggregate(plan)
+        if agg is None:
+            return None
+        sp = streaming.try_streaming_plan(
+            plan, lambda t: self._est_rows.get(t, 0), self.config.chunk_rows)
+        if sp is None:
+            return None
+
+        morsel_rows = self.config.chunk_rows
+        cap = bucket(morsel_rows)
+        morsels = self.iter_morsels(sp.big_table, sp.big_columns, morsel_rows)
+
+        current: dict = {}
+
+        def load(name, columns=None):
+            if name == streaming.MORSEL_TABLE:
+                t = current["table"]
+                return t.select(list(columns)) if columns else t
+            return self.load_table(name, columns)
+
+        jexec = JaxExecutor(load, jit_plans=True, mesh=self._device_mesh())
+        partials = []
+        cq = None
+        ent = None
+        mkey = None
+        for morsel in morsels:
+            current["table"] = morsel
+            if cq is None:  # record once, on the first morsel
+                _out0, decisions, scan_keys = jexec.record_plan(
+                    sp.partial_plan)
+                if jexec.fallback_nodes:
+                    return None  # not device-runnable; use the normal path
+                decisions = streaming.inflate_schedule(decisions, morsel_rows)
+                cq = CompiledQuery(sp.partial_plan, decisions, scan_keys)
+                ent = {"scan_keys": scan_keys}
+                mkey = next(k for k in scan_keys
+                            if k.startswith(streaming.MORSEL_TABLE + "//"))
+            cols = mkey.split("//", 1)[1].split(",")
+            jexec._scan_cache[mkey] = to_device(morsel.select(cols),
+                                                capacity=cap)
+            try:
+                out = cq.run(jexec._scans_for(ent))
+            except ReplayMismatch:
+                # a morsel genuinely exceeded the inflated schedule (e.g. a
+                # non-unique build side expanded): run it eagerly — after
+                # evicting the PREVIOUS morsel from the record-side scan
+                # cache (split from the replay cache on accelerator/mesh
+                # backends), or the eager pass would re-aggregate stale rows
+                jexec._scan_cache_rec.pop(mkey, None)
+                jexec._scan_cache.pop(mkey, None)
+                out, _, _ = jexec.record_plan(sp.partial_plan)
+            partials.append(arrow_bridge.to_arrow(to_host(out)))
+
+        if not partials:
+            return None  # empty source: the in-core path handles it
+        merged_arrow = pa.concat_tables(partials, promote_options="permissive")
+        merged = arrow_bridge.from_arrow(merged_arrow)
+        from .plan import MaterializedNode
+        mat = MaterializedNode(table=merged, label="streamed-partials",
+                               out_names=list(sp.partial_names),
+                               out_dtypes=list(sp.partial_dtypes))
+        final_plan = streaming.rebuild_above(path, sp.build_final(mat))
+        result = Executor(self.load_table).execute(final_plan)
+        self.last_exec_stats = {"mode": "streaming",
+                                "morsels": len(partials),
+                                "morsel_rows": morsel_rows}
+        return result
 
     def sql_arrow(self, query: str) -> pa.Table:
         return arrow_bridge.to_arrow(self.sql(query))

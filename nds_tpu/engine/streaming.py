@@ -1,0 +1,229 @@
+"""Out-of-core execution: morsel-streamed scan -> filter/join -> partial agg.
+
+The single-chip answer to "the table does not fit" (SURVEY.md §5 long-context
+analog; the reference bounds scans with
+spark.sql.files.maxPartitionBytes=2gb chunking + shuffle spill,
+power_run_gpu.template SPARK_CONF): when a plan aggregates over ONE large
+scan through per-row operators (filters, projections, joins whose build
+sides are dimension-sized), the large table streams through the device in
+fixed-capacity morsels. Each morsel runs the SAME compiled XLA program
+(capacities inflated to the morsel bound, so the schedule holds for every
+morsel); per-morsel partial aggregates merge on host, and a final plan
+recomputes the query's aggregate output from the partials.
+
+Eligibility is decided on the BOUND plan; ineligible plans (windows,
+distinct aggs, stddev, big-scan string payloads, multiple big scans) simply
+run the normal in-core path.
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import replace
+from typing import Optional
+
+from . import plan as P
+from .plan import (AggregateNode, AggSpec, BCall, BCol, FilterNode, JoinNode,
+                   LimitNode, MaterializedNode, PlanNode, ProjectNode,
+                   ScanNode, SortNode, walk)
+
+MORSEL_TABLE = "__morsel__"
+
+
+@dataclasses.dataclass
+class StreamingPlan:
+    """A rewritten plan pair: per-morsel partial plan + final merge plan."""
+    big_table: str                 # source table being streamed
+    big_columns: list[str]         # projected columns of the big scan
+    partial_plan: PlanNode         # aggregates one morsel (scan = MORSEL_TABLE)
+    partial_names: list[str]
+    partial_dtypes: list[str]
+    build_final: "callable"        # (partials Materialized) -> final PlanNode
+
+
+def _path_to_aggregate(plan: PlanNode):
+    """Locate the single AggregateNode with only post-agg nodes above it."""
+    path = []
+    node = plan
+    while True:
+        if isinstance(node, AggregateNode):
+            return path, node
+        if isinstance(node, (SortNode, LimitNode, ProjectNode, FilterNode)) \
+                and not isinstance(node, AggregateNode):
+            path.append(node)
+            node = node.child
+            continue
+        return None, None
+
+
+def _big_scan(sub: PlanNode, est_rows, threshold: int
+              ) -> Optional[ScanNode]:
+    """The unique streaming-eligible big scan under the aggregate, if any.
+
+    The big scan must sit on the LEFT spine (probe side): every JoinNode on
+    the path from the aggregate to it must have the big lineage as `left`
+    with an inner/left/semi/anti kind, and all other scans must be small.
+    """
+    scans = [n for n in walk(sub) if isinstance(n, ScanNode)]
+    big = [s for s in scans if est_rows(s.table) > threshold]
+    if len(big) != 1:
+        return None
+    target = big[0]
+
+    def on_left_spine(node) -> bool:
+        if node is target:
+            return True
+        if isinstance(node, (FilterNode, ProjectNode)):
+            return on_left_spine(node.child)
+        if isinstance(node, JoinNode):
+            if node.kind not in ("inner", "left", "semi", "anti"):
+                return False
+            # the big scan must not hide in the build side
+            if any(n is target for n in walk(node.right)):
+                return False
+            return on_left_spine(node.left)
+        return False
+
+    return target if on_left_spine(sub) else None
+
+
+def _contains_unsupported(sub: PlanNode, big: ScanNode) -> bool:
+    for n in walk(sub):
+        if isinstance(n, (P.WindowNode, P.DistinctNode, P.SetOpNode,
+                          AggregateNode)):
+            return True
+    # string payloads from the big scan would need per-morsel dictionaries
+    # (one compiled program could not be reused); group keys and filters on
+    # dimension strings are fine
+    for i, dt in enumerate(big.out_dtypes):
+        if dt == "str":
+            return True
+    return False
+
+
+def try_streaming_plan(plan: PlanNode, est_rows, threshold: int
+                       ) -> Optional[StreamingPlan]:
+    path, agg = _path_to_aggregate(plan)
+    if agg is None or agg.rollup:
+        return None
+    if any(s.distinct for s in agg.aggs):
+        return None
+    if any(s.func not in ("sum", "count", "count_star", "min", "max", "avg")
+           for s in agg.aggs):
+        return None
+    big = _big_scan(agg.child, est_rows, threshold)
+    if big is None or _contains_unsupported(agg.child, big):
+        return None
+    if any(isinstance(n, MaterializedNode) for n in walk(agg.child)):
+        return None
+
+    # ---- partial aggregate: decompose each agg into mergeable pieces ----
+    ngroups = len(agg.group_exprs)
+    partial_specs: list[AggSpec] = []
+    # merge recipe per original agg: list of (piece kind, partial col index)
+    recipes: list[tuple[str, list[int]]] = []
+    for spec in agg.aggs:
+        base = len(partial_specs) + ngroups
+        if spec.func == "count_star":
+            partial_specs.append(replace(spec, name=f"{spec.name}__cs"))
+            recipes.append(("sum_int", [base]))
+        elif spec.func == "count":
+            partial_specs.append(replace(spec, name=f"{spec.name}__c"))
+            recipes.append(("sum_int", [base]))
+        elif spec.func in ("min", "max"):
+            partial_specs.append(spec)
+            recipes.append((spec.func, [base]))
+        elif spec.func == "sum":
+            partial_specs.append(replace(spec, name=f"{spec.name}__s"))
+            partial_specs.append(AggSpec("count", spec.arg, False,
+                                         f"{spec.name}__n"))
+            recipes.append(("sum_guarded", [base, base + 1]))
+        else:  # avg
+            partial_specs.append(AggSpec("sum", spec.arg, False,
+                                         f"{spec.name}__s"))
+            partial_specs.append(AggSpec("count", spec.arg, False,
+                                         f"{spec.name}__n"))
+            recipes.append(("avg", [base, base + 1]))
+
+    # swap the big scan for the morsel pseudo-table
+    def swap(node: PlanNode) -> PlanNode:
+        if node is big:
+            return replace(node, table=MORSEL_TABLE)
+        repl = {}
+        for f in ("child", "left", "right"):
+            sub = getattr(node, f, None)
+            if isinstance(sub, PlanNode):
+                repl[f] = swap(sub)
+        return replace(node, **repl) if repl else node
+
+    p_names = ([f"g{i}" for i in range(ngroups)] +
+               [s.name for s in partial_specs])
+    p_dtypes = ([e.dtype for e in agg.group_exprs] +
+                [s.dtype for s in partial_specs])
+    partial_plan = AggregateNode(
+        child=swap(agg.child), group_exprs=list(agg.group_exprs),
+        aggs=partial_specs, out_names=p_names, out_dtypes=p_dtypes)
+
+    def build_final(partials: MaterializedNode) -> PlanNode:
+        """Re-aggregate the unioned partials, then restore A's schema."""
+        group_refs = [BCol(p_dtypes[i], i, p_names[i])
+                      for i in range(ngroups)]
+        merge_specs: list[AggSpec] = []
+        for spec, (kind, idxs) in zip(agg.aggs, recipes):
+            if kind in ("min", "max"):
+                merge_specs.append(AggSpec(
+                    kind, BCol(p_dtypes[idxs[0]], idxs[0]), False, spec.name))
+            else:
+                for j in idxs:
+                    merge_specs.append(AggSpec(
+                        "sum", BCol(p_dtypes[j], j), False, p_names[j]))
+        m_names = ([p_names[i] for i in range(ngroups)] +
+                   [s.name for s in merge_specs])
+        m_dtypes = ([p_dtypes[i] for i in range(ngroups)] +
+                    [s.dtype for s in merge_specs])
+        merged = AggregateNode(child=partials, group_exprs=group_refs,
+                               aggs=merge_specs,
+                               out_names=m_names, out_dtypes=m_dtypes)
+        # project back to A's output schema
+        exprs: list = [BCol(m_dtypes[i], i, m_names[i])
+                       for i in range(ngroups)]
+        col = ngroups
+        for spec, (kind, idxs) in zip(agg.aggs, recipes):
+            if kind in ("min", "max", "sum_int"):
+                exprs.append(BCol(spec.dtype, col))
+                col += 1
+            elif kind == "sum_guarded":
+                # SUM is NULL iff no non-null input existed anywhere
+                s_ref = BCol(m_dtypes[col], col)
+                n_ref = BCol("int", col + 1)
+                cond = BCall("bool", "gt", [n_ref, P.BLit("int", 0)])
+                exprs.append(BCall(spec.dtype, "case",
+                                   [cond, s_ref, P.BLit(spec.dtype, None)]))
+                col += 2
+            else:  # avg = total sum / total count (NULL when count == 0)
+                s_ref = BCol(m_dtypes[col], col)
+                n_ref = BCol("int", col + 1)
+                exprs.append(BCall("float", "div", [s_ref, n_ref]))
+                col += 2
+        return ProjectNode(merged, exprs, out_names=list(agg.out_names),
+                           out_dtypes=list(agg.out_dtypes))
+
+    return StreamingPlan(big.table, list(big.columns), partial_plan,
+                         p_names, p_dtypes, build_final)
+
+
+def rebuild_above(path: list[PlanNode], new_agg_out: PlanNode) -> PlanNode:
+    """Re-hang the post-aggregate nodes (sort/limit/having/project) over the
+    merged aggregate output."""
+    node = new_agg_out
+    for parent in reversed(path):
+        node = replace(parent, child=node)
+    return node
+
+
+def inflate_schedule(decisions: list, morsel_cap: int) -> list:
+    """Round every capacity decision up to the morsel bound so ONE compiled
+    program serves every morsel (filters/joins against unique dimension keys
+    cannot exceed the morsel row count; a genuine expansion beyond it is
+    caught by the schedule check and re-recorded)."""
+    return [(kind, max(int(v), morsel_cap) if kind == "cap" else v)
+            for kind, v in decisions]

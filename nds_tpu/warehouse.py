@@ -249,7 +249,14 @@ class Warehouse:
             session._est_rows[name] = (est_rows or {}).get(
                 name, dataset.count_rows())
 
-            def load(ds=dataset):
-                return arrow_bridge.from_arrow(ds.to_table())
+            def load(columns=None, ds=dataset):
+                cols = list(columns) if columns is not None else None
+                return arrow_bridge.from_arrow(ds.to_table(columns=cols))
             session._loaders[name] = load
-            session._cache.pop(name, None)
+
+            def batches(columns, ds=dataset):
+                cols = list(columns) if columns is not None else None
+                yield from ds.to_batches(columns=cols)
+            session._batch_sources[name] = batches
+            session._drop_cached(name)
+            session._generation += 1

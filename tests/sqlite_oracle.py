@@ -13,8 +13,12 @@ verified over nds_tpu/templates/*.tpl):
 - ``CAST(x AS DOUBLE)``        -> ``CAST(x AS REAL)``
 - ``a / b``                    -> ``a * 1.0 / b``  (Spark divides in double;
   SQLite would truncate int/int)
-
-Templates using ROLLUP/GROUPING are skipped: SQLite has no grouping sets.
+- ``GROUP BY ROLLUP (c1..ck)`` -> UNION ALL of the k+1 plain GROUP BY
+  prefixes, with grouped-out columns projected as NULL and ``GROUPING(ci)``
+  folded to 0/1 per variant (SQLite has no grouping sets; every rollup in
+  the 99 templates is a plain column-list rollup, so prefix expansion is
+  exact). The grand-total () set is a plain ungrouped aggregate — one row
+  even over empty input, per grouping-sets semantics.
 """
 from __future__ import annotations
 
@@ -38,7 +42,187 @@ _INTERVAL = re.compile(
 _DIV = re.compile(r"(?<![*/])/(?![*/])")
 
 
+_ROLLUP = re.compile(r"GROUP\s+BY\s+ROLLUP\s*\(", re.IGNORECASE)
+_SELECT = re.compile(r"\bSELECT\b", re.IGNORECASE)
+_FROM = re.compile(r"\bFROM\b", re.IGNORECASE)
+
+
+def _depth_at(sql: str, pos: int) -> int:
+    return sql.count("(", 0, pos) - sql.count(")", 0, pos)
+
+
+def _match_paren(sql: str, open_pos: int) -> int:
+    """Index of the ')' matching the '(' at open_pos."""
+    depth = 0
+    for i in range(open_pos, len(sql)):
+        if sql[i] == "(":
+            depth += 1
+        elif sql[i] == ")":
+            depth -= 1
+            if depth == 0:
+                return i
+    raise ValueError("unbalanced parens")
+
+
+def _rollup_variant(select_list: str, cols: list[str], p: int) -> str:
+    """Rewrite a select list for the rollup prefix of length p: GROUPING(c)
+    folds to 0 (grouped) / 1 (rolled up); rolled-up columns project NULL."""
+    for i, c in enumerate(cols):
+        select_list = re.sub(
+            rf"GROUPING\s*\(\s*{re.escape(c)}\s*\)",
+            "0" if i < p else "1", select_list, flags=re.IGNORECASE)
+    for c in cols[p:]:
+        select_list = re.sub(rf"\b{re.escape(c)}\b", "NULL", select_list,
+                             flags=re.IGNORECASE)
+    return select_list
+
+
+def expand_rollup(sql: str) -> str:
+    """Expand every GROUP BY ROLLUP into a UNION ALL of plain GROUP BYs."""
+    m = _ROLLUP.search(sql)
+    if m is None:
+        return sql
+    open_pos = sql.index("(", m.end() - 1)
+    close_pos = _match_paren(sql, open_pos)
+    cols = [c.strip() for c in sql[open_pos + 1:close_pos].split(",")]
+    block_depth = _depth_at(sql, m.start())
+
+    # the SELECT that owns this GROUP BY: last same-depth SELECT before it
+    sel_starts = [s.start() for s in _SELECT.finditer(sql, 0, m.start())
+                  if _depth_at(sql, s.start()) == block_depth]
+    block_start = sel_starts[-1]
+    # its select list ends at the first same-depth FROM
+    from_pos = next(f.start() for f in _FROM.finditer(sql, block_start)
+                    if _depth_at(sql, f.start()) == block_depth)
+    select_list = sql[block_start + len("SELECT"):from_pos]
+    body = sql[from_pos:m.start()]          # FROM ... WHERE ... (untouched)
+
+    # block tail (HAVING/ORDER BY/LIMIT) runs to the paren closing the block
+    tail_end = len(sql)
+    depth = 0
+    for i in range(close_pos + 1, len(sql)):
+        if sql[i] == "(":
+            depth += 1
+        elif sql[i] == ")":
+            depth -= 1
+            if depth < 0:
+                tail_end = i
+                break
+    tail = sql[close_pos + 1:tail_end].strip()
+
+    variants = []
+    for p in range(len(cols), -1, -1):      # leftmost variant names columns
+        group = f" GROUP BY {', '.join(cols[:p])}" if p else ""
+        variants.append(f"SELECT {_rollup_variant(select_list, cols, p)} "
+                        f"{body}{group}")
+    union = " UNION ALL ".join(variants)
+    new_block = f"SELECT * FROM ({union})" + (f" {tail}" if tail else "")
+    return expand_rollup(sql[:block_start] + new_block + sql[tail_end:])
+
+
+_CONCAT = re.compile(r"\bCONCAT\s*\(", re.IGNORECASE)
+_COMPOUND_PARENS = re.compile(
+    r"\)\s*(EXCEPT|INTERSECT|UNION(?:\s+ALL)?)\s*\(", re.IGNORECASE)
+_COMPOUND_BARE_LEFT = re.compile(
+    r"[^)\s]\s*\b(EXCEPT|INTERSECT|UNION(?:\s+ALL)?)\s*\(", re.IGNORECASE)
+
+
+def _split_top_commas(text: str) -> list[str]:
+    parts, depth, start = [], 0, 0
+    for i, ch in enumerate(text):
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            parts.append(text[start:i])
+            start = i + 1
+    parts.append(text[start:])
+    return parts
+
+
+def _rewrite_concat(sql: str) -> str:
+    """CONCAT(a, b, ...) -> (a || b || ...): SQLite has no CONCAT, and ||
+    NULL-propagates exactly like Spark's concat."""
+    m = _CONCAT.search(sql)
+    if m is None:
+        return sql
+    open_pos = sql.index("(", m.end() - 1)
+    close_pos = _match_paren(sql, open_pos)
+    args = _split_top_commas(sql[open_pos + 1:close_pos])
+    joined = "(" + " || ".join(a.strip() for a in args) + ")"
+    return _rewrite_concat(sql[:m.start()] + joined + sql[close_pos + 1:])
+
+
+def _strip_compound_parens(sql: str) -> str:
+    """((SELECT ...) EXCEPT (SELECT ...)) -> (SELECT ... EXCEPT SELECT ...):
+    SQLite rejects parenthesized compound-select members."""
+    m = _COMPOUND_PARENS.search(sql)
+    while m is not None:
+        close_pos = sql.index(")", m.start())
+        # matching '(' of the left member
+        depth = 0
+        open_pos = -1
+        for i in range(close_pos, -1, -1):
+            if sql[i] == ")":
+                depth += 1
+            elif sql[i] == "(":
+                depth -= 1
+                if depth == 0:
+                    open_pos = i
+                    break
+        r_open = sql.index("(", m.end() - 1)
+        r_close = _match_paren(sql, r_open)
+        left_is_select = sql[open_pos + 1:close_pos].lstrip()[:6].upper() == "SELECT"
+        right_is_select = sql[r_open + 1:r_close].lstrip()[:6].upper() == "SELECT"
+        if not (left_is_select and right_is_select):
+            break
+        chars = list(sql)
+        for pos in (open_pos, close_pos, r_open, r_close):
+            chars[pos] = " "
+        sql = "".join(chars)
+        m = _COMPOUND_PARENS.search(sql)
+    # left member already bare (chained compounds): strip the right wrap only
+    m = _COMPOUND_BARE_LEFT.search(sql)
+    while m is not None:
+        r_open = sql.index("(", m.end() - 1)
+        r_close = _match_paren(sql, r_open)
+        if sql[r_open + 1:r_close].lstrip()[:6].upper() != "SELECT":
+            break
+        chars = list(sql)
+        chars[r_open] = " "
+        chars[r_close] = " "
+        sql = "".join(chars)
+        m = _COMPOUND_BARE_LEFT.search(sql)
+    return sql
+
+
+class _StddevSamp:
+    """Sample standard deviation for SQLite (no built-in stddev)."""
+
+    def __init__(self):
+        self.n = 0
+        self.mean = 0.0
+        self.m2 = 0.0     # Welford: numerically stable
+
+    def step(self, value):
+        if value is None:
+            return
+        self.n += 1
+        d = float(value) - self.mean
+        self.mean += d / self.n
+        self.m2 += d * (float(value) - self.mean)
+
+    def finalize(self):
+        if self.n < 2:
+            return None
+        return (self.m2 / (self.n - 1)) ** 0.5
+
+
 def to_sqlite_sql(sql: str) -> str:
+    sql = expand_rollup(sql)
+    sql = _rewrite_concat(sql)
+    sql = _strip_compound_parens(sql)
     sql = _CAST_DATE.sub(lambda m: m.group(1), sql)
     sql = _CAST_DOUBLE.sub("AS REAL)", sql)
     sql = _INTERVAL.sub(
@@ -52,6 +236,7 @@ def to_sqlite_sql(sql: str) -> str:
 def load_database(data_dir: str, use_decimal: bool = False) -> sqlite3.Connection:
     """Load the generated pipe-delimited CSVs into an in-memory SQLite DB."""
     conn = sqlite3.connect(":memory:")
+    conn.create_aggregate("STDDEV_SAMP", 1, _StddevSamp)
     for name, schema in get_schemas(use_decimal).items():
         tdir = os.path.join(data_dir, name)
         if not os.path.isdir(tdir):

@@ -20,6 +20,31 @@ from .plan import (
 )
 
 
+def _loader_takes_columns(loader) -> bool:
+    import inspect
+    try:
+        sig = inspect.signature(loader)
+    except (TypeError, ValueError):
+        return False
+    params = list(sig.parameters.values())
+    positional = [p for p in params
+                  if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)]
+    return len(positional) >= 2 or \
+        any(p.kind == p.VAR_POSITIONAL for p in params)
+
+
+def load_columns(loader: Callable, table: str, columns) -> Table:
+    """Column-pruned load when the loader supports projection (scan pruning;
+    plain single-argument callables keep working for tests/fallback nodes).
+    Shared by the host and device executors."""
+    try:
+        return loader(table, tuple(columns))
+    except TypeError:
+        if _loader_takes_columns(loader):
+            raise    # genuine TypeError inside a projection-aware loader
+        return loader(table)
+
+
 class Executor:
     def __init__(self, load_table: Callable[[str], Table],
                  trace: Optional[Callable[[str, float, int], None]] = None):
@@ -28,12 +53,7 @@ class Executor:
         self._trace = trace
 
     def _load_columns(self, table: str, columns) -> Table:
-        """Column-pruned load when the loader supports projection (scan
-        pruning; plain callables keep working for tests/fallback nodes)."""
-        try:
-            return self._load_table(table, tuple(columns))
-        except TypeError:
-            return self._load_table(table)
+        return load_columns(self._load_table, table, columns)
 
     def execute(self, node: PlanNode) -> Table:
         key = id(node)

@@ -194,13 +194,21 @@ class JaxExecutor:
         if ent is not None:
             if ent["cq"] is not None:                  # steady state
                 try:
-                    return self._run_compiled(ent["cq"], ent)
+                    out = self._run_compiled(ent["cq"], ent)
+                    ent["rt_failures"] = 0
+                    return out
                 except ReplayMismatch:
                     self._plans.pop(key, None)
                     ent = None
                 except jax.errors.JaxRuntimeError as e:
                     # transient infra failure (e.g. remote compile service
-                    # hiccup): serve this call eagerly, keep the program
+                    # hiccup): serve this call eagerly. Two consecutive
+                    # failing episodes = deterministic runtime failure
+                    # (e.g. device OOM); drop the program so the query
+                    # re-records instead of re-running a doomed binary
+                    ent["rt_failures"] = ent.get("rt_failures", 0) + 1
+                    if ent["rt_failures"] >= 2:
+                        self._plans.pop(key, None)
                     self.last_stats.update(mode="eager",
                                            transient=f"{e}"[:200])
                     return self._eager(ent["plan"])
@@ -213,6 +221,7 @@ class JaxExecutor:
                 try:
                     out = self._run_compiled(cq, ent)
                     ent["cq"] = cq
+                    ent["rt_failures"] = 0
                     return out
                 except _NOJIT_ERRORS as e:
                     ent["nojit"] = True
@@ -225,7 +234,10 @@ class JaxExecutor:
                     ent = None
                 except jax.errors.JaxRuntimeError as e:
                     # transient: don't mark nojit — the next execution
-                    # retries compilation
+                    # retries compilation (bounded like the steady state)
+                    ent["rt_failures"] = ent.get("rt_failures", 0) + 1
+                    if ent["rt_failures"] >= 2:
+                        self._plans.pop(key, None)
                     self.last_stats.update(mode="eager",
                                            transient=f"{e}"[:200])
                     return self._eager(ent["plan"])
@@ -253,10 +265,8 @@ class JaxExecutor:
         return out, rec.decisions, tuple(sorted(self._touched_scans))
 
     def _load_columns(self, table: str, columns) -> Table:
-        try:
-            return self._load_table(table, tuple(columns))
-        except TypeError:
-            return self._load_table(table)
+        from ..executor import load_columns
+        return load_columns(self._load_table, table, columns)
 
     def _run_compiled(self, cq: CompiledQuery, ent) -> DTable:
         """Run a compiled plan, retrying once on transient runtime errors

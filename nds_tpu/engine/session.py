@@ -41,6 +41,11 @@ class Session:
         self._generation = 0
         self._jax_exec = None
         self._jax_exec_gen = -1
+        # out-of-core: per-query streaming state (rewritten plan + compiled
+        # morsel program + executor with its scan cache); None = known
+        # not-streamable. Invalidated when the catalog generation moves.
+        self._stream_cache: dict[str, Optional[dict]] = {}
+        self._stream_cache_gen = -1
 
     def _device_mesh(self):
         """Build the SPMD mesh from config.mesh_shape (None = single device).
@@ -278,44 +283,57 @@ class Session:
         from .jax_backend.device import bucket, to_device
         from .jax_backend.executor import CompiledQuery, ReplayMismatch
 
-        plan = Planner(self._catalog()).plan_query(parse_sql(query))
-        path, agg = streaming._path_to_aggregate(plan)
-        if agg is None:
-            return None
-        sp = streaming.try_streaming_plan(
-            plan, lambda t: self._est_rows.get(t, 0), self.config.chunk_rows)
-        if sp is None:
-            return None
-
+        if self._stream_cache_gen != self._generation:
+            self._stream_cache = {}
+            self._stream_cache_gen = self._generation
         morsel_rows = self.config.chunk_rows
         cap = bucket(morsel_rows)
+
+        sent = self._stream_cache.get(query, "miss")
+        if sent is None:          # known not-streamable: skip the re-plan
+            return None
+        if sent == "miss":
+            plan = Planner(self._catalog()).plan_query(parse_sql(query))
+            sp = streaming.try_streaming_plan(
+                plan, lambda t: self._est_rows.get(t, 0),
+                self.config.chunk_rows)
+            if sp is None:
+                self._stream_cache[query] = None
+                return None
+
+            current: dict = {}
+
+            def load(name, columns=None):
+                if name == streaming.MORSEL_TABLE:
+                    t = current["table"]
+                    return t.select(list(columns)) if columns else t
+                return self.load_table(name, columns)
+
+            jexec = JaxExecutor(load, jit_plans=True,
+                                mesh=self._device_mesh())
+            sent = {"sp": sp, "jexec": jexec, "current": current,
+                    "cq": None, "ent": None, "mkey": None}
+            self._stream_cache[query] = sent
+
+        sp, jexec, current = sent["sp"], sent["jexec"], sent["current"]
         morsels = self.iter_morsels(sp.big_table, sp.big_columns, morsel_rows)
-
-        current: dict = {}
-
-        def load(name, columns=None):
-            if name == streaming.MORSEL_TABLE:
-                t = current["table"]
-                return t.select(list(columns)) if columns else t
-            return self.load_table(name, columns)
-
-        jexec = JaxExecutor(load, jit_plans=True, mesh=self._device_mesh())
         partials = []
-        cq = None
-        ent = None
-        mkey = None
         for morsel in morsels:
             current["table"] = morsel
-            if cq is None:  # record once, on the first morsel
+            if sent["cq"] is None:  # record once, on the first morsel
                 _out0, decisions, scan_keys = jexec.record_plan(
                     sp.partial_plan)
                 if jexec.fallback_nodes:
+                    self._stream_cache[query] = None
                     return None  # not device-runnable; use the normal path
                 decisions = streaming.inflate_schedule(decisions, morsel_rows)
-                cq = CompiledQuery(sp.partial_plan, decisions, scan_keys)
-                ent = {"scan_keys": scan_keys}
-                mkey = next(k for k in scan_keys
-                            if k.startswith(streaming.MORSEL_TABLE + "//"))
+                sent["cq"] = CompiledQuery(sp.partial_plan, decisions,
+                                           scan_keys)
+                sent["ent"] = {"scan_keys": scan_keys}
+                sent["mkey"] = next(
+                    k for k in scan_keys
+                    if k.startswith(streaming.MORSEL_TABLE + "//"))
+            cq, ent, mkey = sent["cq"], sent["ent"], sent["mkey"]
             cols = mkey.split("//", 1)[1].split(",")
             jexec._scan_cache[mkey] = to_device(morsel.select(cols),
                                                 capacity=cap)
@@ -332,6 +350,13 @@ class Session:
                 out, _, _ = jexec.record_plan(sp.partial_plan)
             partials.append(arrow_bridge.to_arrow(to_host(out)))
 
+        # free the final morsel: the cached executor must not pin a
+        # chunk_rows-capacity device buffer (or the host morsel) per query
+        if sent["mkey"] is not None:
+            jexec._scan_cache.pop(sent["mkey"], None)
+            jexec._scan_cache_rec.pop(sent["mkey"], None)
+        current.pop("table", None)
+
         if not partials:
             return None  # empty source: the in-core path handles it
         merged_arrow = pa.concat_tables(partials, promote_options="permissive")
@@ -340,7 +365,7 @@ class Session:
         mat = MaterializedNode(table=merged, label="streamed-partials",
                                out_names=list(sp.partial_names),
                                out_dtypes=list(sp.partial_dtypes))
-        final_plan = streaming.rebuild_above(path, sp.build_final(mat))
+        final_plan = streaming.rebuild_above(sp.path, sp.build_final(mat))
         result = Executor(self.load_table).execute(final_plan)
         self.last_exec_stats = {"mode": "streaming",
                                 "morsels": len(partials),

@@ -38,6 +38,8 @@ class StreamingPlan:
     partial_names: list[str]
     partial_dtypes: list[str]
     build_final: "callable"        # (partials Materialized) -> final PlanNode
+    path: list = dataclasses.field(default_factory=list)
+    # post-aggregate nodes above the original aggregate (for rebuild_above)
 
 
 def _path_to_aggregate(plan: PlanNode):
@@ -208,7 +210,7 @@ def try_streaming_plan(plan: PlanNode, est_rows, threshold: int
                            out_dtypes=list(agg.out_dtypes))
 
     return StreamingPlan(big.table, list(big.columns), partial_plan,
-                         p_names, p_dtypes, build_final)
+                         p_names, p_dtypes, build_final, path)
 
 
 def rebuild_above(path: list[PlanNode], new_agg_out: PlanNode) -> PlanNode:

@@ -84,7 +84,8 @@ def expand_rollup(sql: str) -> str:
         return sql
     open_pos = sql.index("(", m.end() - 1)
     close_pos = _match_paren(sql, open_pos)
-    cols = [c.strip() for c in sql[open_pos + 1:close_pos].split(",")]
+    cols = [c.strip()
+            for c in _split_top_commas(sql[open_pos + 1:close_pos])]
     block_depth = _depth_at(sql, m.start())
 
     # the SELECT that owns this GROUP BY: last same-depth SELECT before it

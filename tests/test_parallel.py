@@ -149,7 +149,8 @@ def mesh_session(tmp_path_factory):
 @pytest.mark.parametrize("number", MESH_POWER_SUBSET)
 def test_power_subset_on_mesh_passes_validator(mesh_session, number):
     from nds_tpu import streams, validate
-    from nds_tpu.engine import arrow_bridge
+
+    from test_templates import _rows   # shared row-normalization policy
 
     spmd, oracle_s = mesh_session
     name = f"query{number}"
@@ -160,16 +161,8 @@ def test_power_subset_on_mesh_passes_validator(mesh_session, number):
     assert spmd.last_fallbacks == [], spmd.last_fallbacks
     assert spmd.last_exec_stats.get("mode") in ("compiled", "compile+run")
 
-    def rows(t):
-        at = arrow_bridge.to_arrow(t)
-        cols = [c.to_pylist() for c in at.columns]
-        rws = list(zip(*cols)) if cols else []
-        key = lambda row: tuple((v is None, str(v)) for v in row
-                                if not isinstance(v, float))
-        return sorted(rws, key=key), at.column_names
-
-    rows_e, names = rows(expected)
-    rows_a, _ = rows(actual)
+    rows_e, names = _rows(expected)
+    rows_a, _ = _rows(actual)
     assert len(rows_e) == len(rows_a)
     for re_, ra_ in zip(rows_e, rows_a):
         assert validate.row_equal(re_, ra_, name, names), f"{re_} != {ra_}"

@@ -20,8 +20,11 @@ def _env_bool(name: str, default: bool) -> bool:
 
 @dataclass
 class EngineConfig:
-    # Physical type for DECIMAL columns: "f64" (CPU default — exact enough under the
-    # validator epsilon) or "f32" (TPU MXU/VPU native; pairwise reductions bound error).
+    # Physical type for DECIMAL columns:
+    #   "f64" (default) — doubles; exact enough under the validator epsilon
+    #   "i64" — exact scaled-int64 ("decN" engine dtype): sums/compares on
+    #           integers, SURVEY.md §7's decimal plan (requires x64 for the
+    #           full int64 range; TPU runs S64 as emulated dual-i32)
     decimal_physical: str = "f64"
     # device mesh axis for data-parallel table sharding
     mesh_shape: tuple[int, ...] = ()

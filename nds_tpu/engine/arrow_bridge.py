@@ -10,13 +10,18 @@ import numpy as np
 import pyarrow as pa
 import pyarrow.compute as pc
 
-from .column import Column, Table
+from .column import Column, Table, dec_dtype, dec_scale, is_dec
 
 
-def engine_dtype(t: pa.DataType) -> str:
+def engine_dtype(t: pa.DataType, dec_as_int: bool = False) -> str:
     if pa.types.is_integer(t):
         return "int"
-    if pa.types.is_decimal(t) or pa.types.is_floating(t):
+    if pa.types.is_decimal(t):
+        # dec_as_int: exact scaled-int64 decimals (decimal_physical="i64");
+        # default keeps the f64 mapping (reference decimal toggle,
+        # nds/nds_schema.py:43-47)
+        return dec_dtype(t.scale) if dec_as_int else "float"
+    if pa.types.is_floating(t):
         return "float"
     if pa.types.is_date(t):
         return "date"
@@ -28,9 +33,10 @@ def engine_dtype(t: pa.DataType) -> str:
     raise TypeError(f"unsupported arrow type {t}")
 
 
-def engine_schema(schema: pa.Schema) -> tuple[list[str], list[str]]:
+def engine_schema(schema: pa.Schema,
+                  dec_as_int: bool = False) -> tuple[list[str], list[str]]:
     names = list(schema.names)
-    dtypes = [engine_dtype(f.type) for f in schema]
+    dtypes = [engine_dtype(f.type, dec_as_int) for f in schema]
     return names, dtypes
 
 
@@ -40,11 +46,30 @@ def _chunked_to_array(arr: pa.ChunkedArray | pa.Array) -> pa.Array:
     return arr
 
 
-def from_arrow_column(arr) -> Column:
+def _decimal_to_scaled_i64(arr: pa.Array) -> np.ndarray:
+    """Exact decimal128(p,s) -> value*10^s as int64 (no float round-trip)."""
+    t = arr.type
+    # multiply result precision is p + (s+1) + 1; past 38 arrow refuses
+    if t.precision + t.scale + 2 <= 38:
+        mul = pa.scalar(10 ** t.scale, pa.decimal128(t.scale + 1, 0))
+        ints = pc.cast(pc.multiply(arr, mul), pa.int64(), safe=False)
+        ints = pc.fill_null(ints, 0)
+        return ints.to_numpy(zero_copy_only=False)
+    out = np.zeros(len(arr), dtype=np.int64)     # precision edge: exact loop
+    for i, d in enumerate(arr.to_pylist()):
+        if d is not None:
+            out[i] = int(d.scaleb(t.scale))
+    return out
+
+
+def from_arrow_column(arr, dec_as_int: bool = False) -> Column:
     arr = _chunked_to_array(arr)
     t = arr.type
-    dtype = engine_dtype(t)
+    dtype = engine_dtype(t, dec_as_int)
     null_count = arr.null_count
+    if is_dec(dtype):
+        valid = ~np.asarray(arr.is_null()) if null_count else None
+        return Column(dtype, _decimal_to_scaled_i64(arr), valid)
     if dtype == "str":
         if not pa.types.is_dictionary(t):
             arr = arr.dictionary_encode()
@@ -87,15 +112,23 @@ def from_arrow_column(arr) -> Column:
     return Column("int", np.asarray(vals, dtype=np.int64), valid)
 
 
-def from_arrow(table: pa.Table) -> Table:
+def from_arrow(table: pa.Table, dec_as_int: bool = False) -> Table:
     return Table(list(table.schema.names),
-                 [from_arrow_column(table.column(i))
+                 [from_arrow_column(table.column(i), dec_as_int)
                   for i in range(table.num_columns)])
 
 
 def to_arrow_column(col: Column) -> pa.Array:
     v = col.validity
     mask = None if col.valid is None else ~col.valid
+    if is_dec(col.dtype):
+        # output materialization is post-aggregation (small); exact loop.
+        # precision 20 covers any scaled int64 (<= 19 digits) and keeps the
+        # fast path available if the column round-trips back through
+        # _decimal_to_scaled_i64 (streamed-partials merge)
+        return pa.array(col.decode().tolist(),
+                        type=pa.decimal128(min(38, 20 + dec_scale(col.dtype)),
+                                           dec_scale(col.dtype)))
     if col.dtype == "str":
         codes = np.asarray(col.data)
         d = col.dictionary if col.dictionary is not None \

@@ -7,7 +7,11 @@ cuDF string columns on GPU.
 
 Engine logical dtypes:
     "int"    int64 values
-    "float"  float64 values (decimals map here; see EngineConfig.decimal_physical)
+    "float"  float64 values (decimals map here under decimal_physical="f64")
+    "decN"   scaled int64: value * 10^N stored exactly (decimal_physical=
+             "i64"; the TPU-exact decimal story — XLA has no decimal type,
+             so SUM/MIN/MAX/compare run on integers, divisions on float.
+             Reference keeps DecimalType end-to-end, nds/nds_schema.py:43-47)
     "bool"   bool values
     "date"   int32 days since Unix epoch
     "str"    int32 dictionary codes, `dictionary` holds the values
@@ -30,6 +34,26 @@ _PHYS_DTYPE = {
 }
 
 
+def is_dec(dtype: str) -> bool:
+    """True for scaled-decimal logical dtypes ("dec0", "dec2", ...)."""
+    return dtype.startswith("dec") and dtype[3:].isdigit()
+
+
+def dec_scale(dtype: str) -> int:
+    return int(dtype[3:])
+
+
+def dec_dtype(scale: int) -> str:
+    return f"dec{int(scale)}"
+
+
+def phys_np(dtype: str):
+    """Physical numpy dtype for a logical dtype (decN -> scaled int64)."""
+    if is_dec(dtype):
+        return np.int64
+    return _PHYS_DTYPE[dtype]
+
+
 @dataclass
 class Column:
     dtype: str                      # logical dtype, see module docstring
@@ -38,7 +62,7 @@ class Column:
     dictionary: Optional[np.ndarray] = None  # object array of str, for dtype == "str"
 
     def __post_init__(self):
-        assert self.dtype in _PHYS_DTYPE, self.dtype
+        assert self.dtype in _PHYS_DTYPE or is_dec(self.dtype), self.dtype
 
     def __len__(self) -> int:
         return len(self.data)
@@ -65,6 +89,15 @@ class Column:
     def decode(self) -> np.ndarray:
         """Host object array with None for nulls (output materialization only)."""
         v = self.validity
+        if is_dec(self.dtype):
+            import decimal
+            s = dec_scale(self.dtype)
+            out = np.empty(len(self), dtype=object)
+            data = np.asarray(self.data)
+            for i in range(len(self)):
+                out[i] = decimal.Decimal(int(data[i])).scaleb(-s) if v[i] \
+                    else None
+            return out
         if self.dtype == "str":
             out = np.empty(len(self), dtype=object)
             codes = np.asarray(self.data)
@@ -88,7 +121,7 @@ class Column:
     def from_values(dtype: str, values: np.ndarray,
                     valid: Optional[np.ndarray] = None,
                     dictionary: Optional[np.ndarray] = None) -> "Column":
-        values = np.asarray(values, dtype=_PHYS_DTYPE[dtype])
+        values = np.asarray(values, dtype=phys_np(dtype))
         if valid is not None and bool(valid.all()):
             valid = None
         return Column(dtype, values, valid, dictionary)
@@ -97,12 +130,18 @@ class Column:
     def constant(dtype: str, value, n: int,
                  dictionary: Optional[np.ndarray] = None) -> "Column":
         if value is None:
-            return Column(dtype, np.zeros(n, dtype=_PHYS_DTYPE[dtype]),
+            return Column(dtype, np.zeros(n, dtype=phys_np(dtype)),
                           np.zeros(n, dtype=bool), dictionary)
         if dtype == "str" and dictionary is None:
             dictionary = np.asarray([value], dtype=object)
             value = 0
-        return Column(dtype, np.full(n, value, dtype=_PHYS_DTYPE[dtype]), None,
+        if is_dec(dtype) and not isinstance(value, (int, np.integer)):
+            # python scalar (e.g. scalar-subquery Decimal result) -> scaled
+            import decimal
+            value = int(decimal.Decimal(str(value))
+                        .scaleb(dec_scale(dtype)).to_integral_value(
+                            rounding=decimal.ROUND_HALF_UP))
+        return Column(dtype, np.full(n, value, dtype=phys_np(dtype)), None,
                       dictionary)
 
 

@@ -12,7 +12,8 @@ from typing import Callable, Optional
 
 import numpy as np
 
-from .column import _NULL_CODE, Column, Table, merge_dictionaries
+from .column import (_NULL_CODE, Column, Table, dec_dtype, dec_scale, is_dec,
+                     merge_dictionaries, phys_np)
 from .plan import BCall, BCol, BExpr, BLit, BScalarSubquery
 
 # signature: subquery_eval(plan) -> python scalar (or None)
@@ -69,7 +70,10 @@ def _result_num_dtype(a: Column, b: Column) -> str:
 
 
 def _as_float(col: Column) -> np.ndarray:
-    return np.asarray(col.data, dtype=np.float64)
+    out = np.asarray(col.data, dtype=np.float64)
+    if is_dec(col.dtype):
+        return out / 10.0 ** dec_scale(col.dtype)
+    return out
 
 
 def _align_strings(a: Column, b: Column) -> tuple[np.ndarray, np.ndarray]:
@@ -99,6 +103,10 @@ def _arith(op):
         da, db = _numeric(a), _numeric(b)
         out = {"add": np.add, "sub": np.subtract, "mul": np.multiply,
                "mod": np.fmod}[op](da.astype(np.int64), db.astype(np.int64))
+        if is_dec(expr.dtype):
+            # operands arrive scale-aligned (add/sub) or raw (mul: scales
+            # add); the scaled-int result is already in the output scale
+            return Column.from_values(expr.dtype, out, valid)
         dtype = expr.dtype if expr.dtype in ("int", "date") else "int"
         return Column.from_values(dtype, out, valid)
     return run
@@ -179,6 +187,21 @@ def _isnotnull(expr: BCall, table: Table, sq) -> Column:
 
 # -- predicates -------------------------------------------------------------
 
+def _scaled_in_values(values, s: int) -> list[int]:
+    """Exact scaled-int IN-list values; literals not representable at scale
+    s can never equal a decN column value, so they drop out. Decimal-exact
+    (float(v)*10**s carries binary noise: 1.1*100 == 110.00000000000001)."""
+    import decimal
+    out = []
+    for v in values:
+        if v is None:
+            continue
+        d = decimal.Decimal(str(v)).scaleb(s)
+        if d == d.to_integral_value():
+            out.append(int(d))
+    return out
+
+
 def _in_list(expr: BCall, table: Table, sq) -> Column:
     a = evaluate(expr.args[0], table, sq)
     values = expr.extra  # list of python literals
@@ -190,6 +213,9 @@ def _in_list(expr: BCall, table: Table, sq) -> Column:
         codes = np.asarray(a.data)
         safe = np.where(codes >= 0, codes, 0)
         out = np.where(codes >= 0, hit[safe] if len(hit) else False, False)
+    elif is_dec(a.dtype):
+        vals = _scaled_in_values(values, dec_scale(a.dtype))
+        out = np.isin(np.asarray(a.data), np.asarray(vals, dtype=np.int64))
     else:
         vals = [v for v in values if v is not None]
         out = np.isin(np.asarray(a.data), np.asarray(vals))
@@ -283,8 +309,16 @@ def _coalesce(expr: BCall, table: Table, sq) -> Column:
 # -- casts & scalar functions ----------------------------------------------
 
 def _phys(dtype: str):
-    return {"int": np.int64, "float": np.float64, "bool": np.bool_,
-            "date": np.int32, "str": np.int32}[dtype]
+    return phys_np(dtype)
+
+
+def _halfup_rescale(data: np.ndarray, from_scale: int,
+                    to_scale: int) -> np.ndarray:
+    """Rescale scaled ints, SQL half-up on downscale (sign-symmetric)."""
+    if to_scale >= from_scale:
+        return data * 10 ** (to_scale - from_scale)
+    factor = 10 ** (from_scale - to_scale)
+    return np.sign(data) * ((np.abs(data) + factor // 2) // factor)
 
 
 def _cast(expr: BCall, table: Table, sq) -> Column:
@@ -292,6 +326,43 @@ def _cast(expr: BCall, table: Table, sq) -> Column:
     target = expr.dtype
     if target == a.dtype:
         return a
+    if is_dec(target):
+        s = dec_scale(target)
+        if is_dec(a.dtype):
+            out = _halfup_rescale(np.asarray(a.data), dec_scale(a.dtype), s)
+            return Column.from_values(target, out, a.valid)
+        if a.dtype in ("int", "bool"):
+            return Column.from_values(
+                target, np.asarray(a.data, dtype=np.int64) * 10 ** s, a.valid)
+        if a.dtype == "float":
+            d = np.asarray(a.data, dtype=np.float64) * 10.0 ** s
+            out = (np.floor(np.abs(d) + 0.5) * np.sign(d)).astype(np.int64)
+            return Column.from_values(target, out, a.valid)
+        if a.dtype == "str":
+            import decimal
+            vals = a.decode()
+            out = np.zeros(len(a), dtype=np.int64)
+            valid = a.validity.copy()
+            for i, v in enumerate(vals):
+                if v is None:
+                    continue
+                try:
+                    out[i] = int(decimal.Decimal(v).scaleb(s)
+                                 .to_integral_value(decimal.ROUND_HALF_UP))
+                except decimal.InvalidOperation:
+                    valid[i] = False
+            return Column.from_values(target, out, valid)
+        raise NotImplementedError(f"cast {a.dtype} -> {target}")
+    if is_dec(a.dtype):
+        s = dec_scale(a.dtype)
+        data = np.asarray(a.data)
+        if target == "float":
+            return Column.from_values(
+                "float", data.astype(np.float64) / 10.0 ** s, a.valid)
+        if target == "int":  # Spark truncates decimal -> int toward zero
+            out = np.sign(data) * (np.abs(data) // 10 ** s)
+            return Column.from_values("int", out, a.valid)
+        # fall through for "str": decode() yields Decimal objects below
     if target in ("int", "float"):
         if a.dtype == "str":
             vals = a.decode()
@@ -334,6 +405,9 @@ def _cast(expr: BCall, table: Table, sq) -> Column:
 def _sql_str(v) -> str:
     if isinstance(v, float) and v.is_integer():
         return str(int(v))
+    import decimal
+    if isinstance(v, decimal.Decimal):
+        return format(v, "f")    # no scientific notation (Spark cast format)
     return str(v)
 
 
@@ -399,6 +473,14 @@ def _abs(expr: BCall, table: Table, sq) -> Column:
 def _round(expr: BCall, table: Table, sq) -> Column:
     a = evaluate(expr.args[0], table, sq)
     digits = expr.extra if expr.extra is not None else 0
+    if is_dec(a.dtype) and is_dec(expr.dtype):
+        # round to `digits` (may be negative: round-to-hundreds), then
+        # restore the output scale (clamped at 0 — decN has no negative
+        # scale, so round(x,-2) yields dec0 values like 12300)
+        out = _halfup_rescale(np.asarray(a.data), dec_scale(a.dtype),
+                              int(digits))
+        out = out * 10 ** (dec_scale(expr.dtype) - int(digits))
+        return Column.from_values(expr.dtype, out, a.valid)
     data = _as_float(a)
     # SQL half-up rounding (numpy rounds half-to-even)
     scale = 10.0 ** digits

@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..column import Column, Table
+from ..column import Column, Table, is_dec, phys_np
 
 _NULL_CODE = -1
 
@@ -32,6 +32,11 @@ def bucket(n: int, minimum: int = 8) -> int:
 
 def phys_dtype(logical: str):
     x64 = jax.config.read("jax_enable_x64")
+    if is_dec(logical):
+        # scaled-int decimal: exact under x64 (TPU S64 is emulated dual-i32
+        # — adds/compares, no MXU needed); i32 without x64 bounds SF (the
+        # bench path keeps decimal_physical="f64" there)
+        return jnp.int64 if x64 else jnp.int32
     return {
         "int": jnp.int64 if x64 else jnp.int32,
         "float": jnp.float64 if x64 else jnp.float32,
@@ -165,8 +170,7 @@ def to_host(dt: DTable, count: Optional[int] = None) -> Table:
         valid = np.asarray(c.valid)[idx]
         if c.dtype == "str":
             data = np.where(valid, data, _NULL_CODE).astype(np.int32)
-        host_dtype = {"int": np.int64, "float": np.float64, "bool": np.bool_,
-                      "date": np.int32, "str": np.int32}[c.dtype]
+        host_dtype = phys_np(c.dtype)
         cols.append(Column(c.dtype, data.astype(host_dtype),
                            None if bool(valid.all()) else valid, c.dictionary))
     return Table(list(dt.names), cols)

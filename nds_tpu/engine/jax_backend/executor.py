@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..column import Table
+from ..column import Table, dec_scale, is_dec
 from ..executor import Executor as HostExecutor
 from ..plan import (
     AggregateNode, AggSpec, BExpr, DistinctNode, FilterNode, JoinNode,
@@ -564,6 +564,8 @@ class JaxExecutor:
                 sarg, func)
             data = jnp.zeros(n, vals_s.dtype).at[perm].set(vals_s)
             dvalid = jnp.zeros(n, bool).at[perm].set(valid_s)
+        if arg_col is not None and is_dec(arg_col.dtype) and wf.func == "avg":
+            data = data / 10.0 ** dec_scale(arg_col.dtype)  # descale
         pd = phys_dtype(wf.dtype)
         return DCol(wf.dtype, data.astype(pd), dvalid & child.alive)
 
@@ -639,11 +641,16 @@ class JaxExecutor:
             arg = None
             if arg_col is not None:
                 data = arg_col.canon().data
-                if spec.func == "sum" and arg_col.dtype == "int":
+                if spec.func == "sum" and (arg_col.dtype == "int"
+                                           or is_dec(arg_col.dtype)):
                     data = data.astype(phys_dtype("int"))
                 arg = (data, arg_col.valid)
             vals, valid = kernels.agg_apply(gid, use_alive, spec.func, arg,
                                             cap_out)
+            if arg_col is not None and is_dec(arg_col.dtype) and \
+                    spec.func in ("avg", "stddev_samp"):
+                # the kernel averaged SCALED ints; descale to float value
+                vals = vals / 10.0 ** dec_scale(arg_col.dtype)
             out.append(DCol(spec.dtype, vals.astype(phys_dtype(spec.dtype)),
                             valid))
         return out

@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..column import dec_scale, is_dec
 from ..plan import BCall, BCol, BExpr, BLit, BScalarSubquery
 from .device import DCol, DTable, phys_dtype, string_rank_lut
 
@@ -26,6 +27,14 @@ SubqueryEval = Callable[[object], object]
 
 def _float_dtype():
     return jnp.float64 if jax.config.read("jax_enable_x64") else jnp.float32
+
+
+def _to_float(c: DCol) -> jax.Array:
+    """Numeric column as float (decN descales: scaled int -> value)."""
+    out = c.data.astype(_float_dtype())
+    if is_dec(c.dtype):
+        out = out / 10.0 ** dec_scale(c.dtype)
+    return out
 
 
 def evaluate(expr: BExpr, table: DTable,
@@ -122,8 +131,7 @@ def _arith(op: str):
         a, b = _args(expr, table, sq)
         valid = _both(a, b)
         if op == "div":
-            fd = _float_dtype()
-            da, db = a.data.astype(fd), b.data.astype(fd)
+            da, db = _to_float(a), _to_float(b)
             zero = db == 0
             out = da / jnp.where(zero, 1.0, db)
             return DCol("float", jnp.where(valid & ~zero, out, 0.0),
@@ -131,11 +139,13 @@ def _arith(op: str):
         fns = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
                "mod": jnp.fmod}
         if a.dtype == "float" or b.dtype == "float" or expr.dtype == "float":
-            fd = _float_dtype()
-            out = fns[op](a.data.astype(fd), b.data.astype(fd))
+            out = fns[op](_to_float(a), _to_float(b))
             return DCol("float", jnp.where(valid, out, 0.0), valid)
         pd = phys_dtype("int")
         out = fns[op](a.data.astype(pd), b.data.astype(pd))
+        if is_dec(expr.dtype):
+            # scale-aligned (add/sub) or raw scaled-int product (mul)
+            return DCol(expr.dtype, jnp.where(valid, out, 0), valid)
         dtype = expr.dtype if expr.dtype in ("int", "date") else "int"
         out = out.astype(phys_dtype(dtype))
         return DCol(dtype, jnp.where(valid, out, 0), valid)
@@ -216,6 +226,11 @@ def _in_list(expr: BCall, table: DTable, sq) -> DCol:
         vset = {v for v in values if v is not None}
         hit = np.asarray([v in vset for v in d], dtype=bool)
         out = _lut_gather(a.data, hit) if len(d) else jnp.zeros(len(a), bool)
+    elif is_dec(a.dtype):
+        from ..exprs import _scaled_in_values
+        vals = _scaled_in_values(values, dec_scale(a.dtype))
+        out = jnp.isin(a.data, jnp.asarray(vals, a.data.dtype)) if vals \
+            else jnp.zeros(a.data.shape, bool)
     else:
         vals = [v for v in values if v is not None]
         if not vals:
@@ -315,6 +330,14 @@ def _nullif(expr: BCall, table: DTable, sq) -> DCol:
 
 # -- casts & scalar functions ------------------------------------------------
 
+def _halfup_rescale(data: jax.Array, from_scale: int,
+                    to_scale: int) -> jax.Array:
+    if to_scale >= from_scale:
+        return data * 10 ** (to_scale - from_scale)
+    factor = 10 ** (from_scale - to_scale)
+    return jnp.sign(data) * ((jnp.abs(data) + factor // 2) // factor)
+
+
 def _cast(expr: BCall, table: DTable, sq) -> DCol:
     a = evaluate(expr.args[0], table, sq)
     target = expr.dtype
@@ -324,6 +347,26 @@ def _cast(expr: BCall, table: DTable, sq) -> DCol:
         return _cast_from_str(a, target)
     if target == "str":
         return _cast_to_str(a)
+    if is_dec(target):
+        s = dec_scale(target)
+        if is_dec(a.dtype):
+            out = _halfup_rescale(a.data, dec_scale(a.dtype), s)
+        elif a.dtype == "float":
+            d = a.data.astype(_float_dtype()) * 10.0 ** s
+            out = (jnp.floor(jnp.abs(d) + 0.5) * jnp.sign(d)) \
+                .astype(phys_dtype(target))
+        else:   # int/bool
+            out = a.data.astype(phys_dtype(target)) * 10 ** s
+        return DCol(target, out, a.valid)
+    if is_dec(a.dtype):
+        s = dec_scale(a.dtype)
+        if target == "float":
+            return DCol("float", a.data.astype(_float_dtype()) / 10.0 ** s,
+                        a.valid)
+        if target == "int":   # truncate toward zero (Spark decimal -> int)
+            out = jnp.sign(a.data) * (jnp.abs(a.data) // 10 ** s)
+            return DCol("int", out.astype(phys_dtype("int")), a.valid)
+        raise NotImplementedError(f"cast {a.dtype} -> {target}")
     if target in ("int", "float", "date"):
         return DCol(target, a.data.astype(phys_dtype(target)), a.valid)
     raise NotImplementedError(f"cast to {target}")
@@ -345,6 +388,10 @@ def _cast_to_str(a: DCol) -> DCol:
     uniq_raw, inverse = np.unique(data, return_inverse=True)
     if a.dtype == "date":
         strs = [str(np.datetime64(int(v), "D").item()) for v in uniq_raw]
+    elif is_dec(a.dtype):
+        import decimal
+        strs = [_sql_str(decimal.Decimal(int(v)).scaleb(-dec_scale(a.dtype)))
+                for v in uniq_raw]
     else:
         strs = [_sql_str(v) for v in uniq_raw]
     uniq, remap = np.unique(np.asarray(strs, dtype=object).astype(str),
@@ -355,10 +402,12 @@ def _cast_to_str(a: DCol) -> DCol:
 
 def _cast_from_str(a: DCol, target: str) -> DCol:
     """Parse the dictionary on the host; codes gather the parsed values."""
+    import decimal
     d = _dict(a)
     vals = np.zeros(max(len(d), 1),
-                    dtype={"int": np.int64, "float": np.float64,
-                           "date": np.int32}[target])
+                    dtype=np.int64 if is_dec(target) else
+                    {"int": np.int64, "float": np.float64,
+                     "date": np.int32}[target])
     ok = np.zeros(max(len(d), 1), dtype=bool)
     for i, v in enumerate(d):
         try:
@@ -366,10 +415,13 @@ def _cast_from_str(a: DCol, target: str) -> DCol:
                 vals[i] = np.datetime64(v, "D").astype(np.int32)
             elif target == "int":
                 vals[i] = int(float(v))
+            elif is_dec(target):
+                vals[i] = int(decimal.Decimal(v).scaleb(dec_scale(target))
+                              .to_integral_value(decimal.ROUND_HALF_UP))
             else:
                 vals[i] = float(v)
             ok[i] = True
-        except (ValueError, TypeError):
+        except (ValueError, TypeError, decimal.InvalidOperation):
             pass
     out = _lut_gather(a.data, vals).astype(phys_dtype(target))
     valid = a.valid & _lut_gather(a.data, ok)
@@ -388,6 +440,24 @@ def _substr(expr: BCall, table: DTable, sq) -> DCol:
     uniq, remap = np.unique(newd.astype(str), return_inverse=True)
     codes = _lut_gather(a.data, remap.astype(np.int32))
     return DCol("str", codes, a.valid, uniq.astype(object))
+
+
+def _case_map(fn):
+    """Row-wise string transform as a dictionary transform (host-side map
+    over the distinct values; codes re-gather on device — strings never
+    reach the accelerator)."""
+    def run(expr: BCall, table: DTable, sq) -> DCol:
+        a = evaluate(expr.args[0], table, sq)
+        if a.dtype != "str":
+            raise NotImplementedError("string transform on non-string")
+        d = _dict(a)
+        if len(d) == 0:
+            return DCol("str", a.data, a.valid, np.empty(0, dtype=object))
+        newd = np.asarray([fn(v) for v in d.astype(str)], dtype=object)
+        uniq, remap = np.unique(newd.astype(str), return_inverse=True)
+        codes = _lut_gather(a.data, remap.astype(np.int32))
+        return DCol("str", codes, a.valid, uniq.astype(object))
+    return run
 
 
 def _concat(expr: BCall, table: DTable, sq) -> DCol:
@@ -411,7 +481,12 @@ def _abs(expr: BCall, table: DTable, sq) -> DCol:
 def _round(expr: BCall, table: DTable, sq) -> DCol:
     a = evaluate(expr.args[0], table, sq)
     digits = expr.extra if expr.extra is not None else 0
-    data = a.data.astype(_float_dtype())
+    if is_dec(a.dtype) and is_dec(expr.dtype):
+        # negative digits: round to tens/hundreds, then restore scale 0
+        out = _halfup_rescale(a.data, dec_scale(a.dtype), int(digits))
+        out = out * 10 ** (dec_scale(expr.dtype) - int(digits))
+        return DCol(expr.dtype, out, a.valid)
+    data = _to_float(a)
     scale = 10.0 ** digits
     out = jnp.floor(jnp.abs(data) * scale + 0.5) / scale * jnp.sign(data)
     if expr.dtype == "int":
@@ -436,5 +511,6 @@ _HANDLERS = {
     "in_list": _in_list, "like": _like,
     "case": _case, "coalesce": _coalesce, "cast": _cast,
     "substr": _substr, "concat": _concat, "abs": _abs, "round": _round,
+    "upper": _case_map(str.upper), "lower": _case_map(str.lower),
     "nullif": _nullif, "grouping_bit": _grouping_bit,
 }

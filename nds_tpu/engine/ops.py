@@ -15,7 +15,8 @@ from __future__ import annotations
 
 import numpy as np
 
-from .column import Column, Table, concat_columns, merge_dictionaries
+from .column import (Column, Table, concat_columns, dec_scale, is_dec,
+                     merge_dictionaries)
 from .plan import AggSpec, SortKey, WindowFunc
 
 _I64_NULL = np.int64(np.iinfo(np.int64).min + 1)
@@ -197,10 +198,15 @@ def compute_agg(spec: AggSpec, arg: Column | None, gid: np.ndarray,
     if spec.func in ("sum", "avg"):
         sums, counts = _segment_sum(values, valid, gid, ngroups)
         if spec.func == "sum":
-            dtype = "float" if arg.dtype == "float" else "int"
+            # decimal sums stay exact scaled int64 (the TPU decimal story);
+            # float stays float, everything else sums as int
+            dtype = arg.dtype if arg.dtype == "float" or is_dec(arg.dtype) \
+                else "int"
             return Column.from_values(dtype, sums, counts > 0)
         with np.errstate(invalid="ignore"):
             avg = sums / np.maximum(counts, 1)
+        if is_dec(arg.dtype):
+            avg = avg / 10.0 ** dec_scale(arg.dtype)
         return Column.from_values("float", avg, counts > 0)
     if spec.func in ("min", "max"):
         out, counts = _segment_minmax(values, valid, gid, ngroups,
@@ -211,6 +217,8 @@ def compute_agg(spec: AggSpec, arg: Column | None, gid: np.ndarray,
         return Column.from_values(arg.dtype, out.astype(values.dtype), counts > 0)
     if spec.func == "stddev_samp":
         v = values.astype(np.float64)
+        if is_dec(arg.dtype):
+            v = v / 10.0 ** dec_scale(arg.dtype)
         sums, counts = _segment_sum(v, valid, gid, ngroups)
         sq, _ = _segment_sum(v * v, valid, gid, ngroups)
         cnt = counts.astype(np.float64)
@@ -564,11 +572,15 @@ def _window_ordered(wf: WindowFunc, arg: Column | None, gid: np.ndarray,
         run_sum = _spread_ties_last(run_sum, same_as_prev)
         run_count = _spread_ties_last(run_count, same_as_prev)
         if wf.func == "sum":
-            dtype = "float" if arg.dtype == "float" else "int"
+            # dec window sums cumulate scaled ints in f64 (exact < 2^53)
+            dtype = "float" if arg.dtype == "float" else \
+                arg.dtype if is_dec(arg.dtype) else "int"
             vals = run_sum if dtype == "float" else run_sum.astype(np.int64)
             return Column.from_values(dtype, vals[inv], (run_count > 0)[inv])
         with np.errstate(invalid="ignore"):
             res = run_sum / np.maximum(run_count, 1)
+        if is_dec(arg.dtype):
+            res = res / 10.0 ** dec_scale(arg.dtype)
         return Column.from_values("float", res[inv], (run_count > 0)[inv])
     if wf.func in ("min", "max"):
         fn = np.minimum if wf.func == "min" else np.maximum
@@ -576,7 +588,8 @@ def _window_ordered(wf: WindowFunc, arg: Column | None, gid: np.ndarray,
         vals = np.where(valid, data, init)
         out = _segmented_accumulate(vals, new_part, fn)
         out = _spread_ties_last(out, same_as_prev)
-        dtype = arg.dtype if arg.dtype in ("int", "float", "date") else "float"
+        dtype = arg.dtype if arg.dtype in ("int", "float", "date") \
+            or is_dec(arg.dtype) else "float"
         cast = out if dtype == "float" else out.astype(np.int64)
         return Column.from_values(dtype, cast[inv], (run_count > 0)[inv])
     raise NotImplementedError(f"window {wf.func}")

@@ -16,6 +16,7 @@ The reference delegates all of this to Spark Catalyst (nds_power.py:129
 from __future__ import annotations
 
 import datetime as _dt
+import re
 from dataclasses import dataclass, field, replace
 from typing import Callable, Optional
 
@@ -23,6 +24,7 @@ import numpy as np
 
 from ..sql import ast_nodes as A
 from . import plan as P
+from .column import dec_dtype, dec_scale, is_dec
 
 
 class PlanError(ValueError):
@@ -71,6 +73,9 @@ class Scope:
 class Catalog:
     """Maps table names to (schema, row-count estimate, loader)."""
     tables: dict = field(default_factory=dict)  # name -> (names, dtypes, est_rows)
+    # decimal_physical="i64": CAST(x AS DECIMAL(p,s)) binds to "dec{s}"
+    # instead of float (exact scaled-int64 decimals)
+    dec_enabled: bool = False
 
     def schema(self, name: str) -> tuple[list[str], list[str]]:
         if name not in self.tables:
@@ -114,9 +119,16 @@ class Planner:
             right = self._plan_body(body.right, outer, ctes, [], None)
             if len(left.out_names) != len(right.out_names):
                 raise PlanError("set operation column count mismatch")
+            # positionally coerce branches to a common dtype (decimal scales
+            # in particular must match: scaled ints of different scales must
+            # never concatenate raw)
+            target = [a if a == b else _common_dtype([a, b])
+                      for a, b in zip(left.out_dtypes, right.out_dtypes)]
+            left = self._coerce_branch(left, target)
+            right = self._coerce_branch(right, target)
             node = P.SetOpNode(body.op, body.all, left, right,
                                out_names=list(left.out_names),
-                               out_dtypes=list(left.out_dtypes))
+                               out_dtypes=list(target))
             node = self._order_limit_by_position(node, order_by, limit)
             return node
         if isinstance(body, A.Query):
@@ -125,6 +137,15 @@ class Planner:
         if isinstance(body, A.Select):
             return self._plan_select(body, outer, ctes, order_by, limit)
         raise PlanError(f"unsupported query body {type(body).__name__}")
+
+    def _coerce_branch(self, node: P.PlanNode, target: list[str]) -> P.PlanNode:
+        """Project a set-op branch onto the positional target dtypes."""
+        if list(node.out_dtypes) == list(target):
+            return node
+        exprs = [_coerce_to(P.BCol(d, i, node.out_names[i]), t)
+                 for i, (d, t) in enumerate(zip(node.out_dtypes, target))]
+        return P.ProjectNode(node, exprs, out_names=list(node.out_names),
+                             out_dtypes=list(target))
 
     def _order_limit_by_position(self, node: P.PlanNode, order_by, limit):
         if order_by:
@@ -970,7 +991,12 @@ class _Binder:
             return self._bind_date_interval(node, op)
         left = self.bind(node.left)
         right = self.bind(node.right)
-        left, right = _coerce_pair(left, right)
+        # mul/div/mod keep decimal operands unscaled: dec(s)*int multiplies
+        # raw int64s (scale s), div/mod go through float — aligning scales
+        # first would only waste int64 range (SF1000 money sums approach it)
+        if not (op in ("mul", "div", "mod")
+                and (is_dec(left.dtype) or is_dec(right.dtype))):
+            left, right = _coerce_pair(left, right)
         if op in ("eq", "ne", "lt", "le", "gt", "ge"):
             return P.BCall("bool", op, [left, right])
         if op in ("and", "or"):
@@ -1082,7 +1108,10 @@ class _Binder:
     def _bind_cast(self, node: A.Cast) -> P.BExpr:
         e = self.bind(node.expr)
         t = node.to_type
-        if t.startswith("decimal") or t in ("double", "float", "real"):
+        if t.startswith("decimal") and self.planner.catalog.dec_enabled:
+            m = re.match(r"decimal\s*\(\s*\d+\s*,\s*(\d+)\s*\)", t)
+            target = dec_dtype(int(m.group(1)) if m else 0)
+        elif t.startswith("decimal") or t in ("double", "float", "real"):
             target = "float"
         elif t in ("int", "integer", "bigint", "long", "smallint", "tinyint"):
             target = "int"
@@ -1119,8 +1148,13 @@ class _Binder:
         if name == "round":
             digits = args[1].value if len(args) > 1 and \
                 isinstance(args[1], P.BLit) else 0
-            return P.BCall("float", "round", [args[0]], extra=digits)
+            out = dec_dtype(max(int(digits), 0)) \
+                if is_dec(args[0].dtype) else "float"
+            return P.BCall(out, "round", [args[0]], extra=digits)
         if name == "nullif":
+            if is_dec(args[0].dtype) or is_dec(args[1].dtype):
+                a0, a1 = _coerce_pair(args[0], args[1])
+                return P.BCall(a0.dtype, "nullif", [a0, a1])
             return P.BCall(args[0].dtype, "nullif", args)
         if name == "grouping":
             e = self.scope.resolve_local("__grouping_id", None)
@@ -1477,6 +1511,8 @@ def _const_fold(e: P.BExpr) -> P.BExpr:
     if fn is None:
         return e
     args = [_const_fold(a) for a in e.args]
+    if e.op == "div" and any(is_dec(a.dtype) for a in args):
+        return e    # scaled-int literal division would drop the scales
     if all(isinstance(a, P.BLit) and a.value is not None for a in args):
         try:
             return P.BLit(e.dtype, fn(*[a.value for a in args]))
@@ -1496,6 +1532,14 @@ def _common_dtype(dtypes: list[str]) -> str:
             return "str"
     if len(s) == 1:
         return next(iter(s))
+    decs = {d for d in s if is_dec(d)}
+    if decs:
+        rest = s - decs
+        if rest <= {"int"}:              # dec + int -> widest decimal scale
+            return dec_dtype(max(dec_scale(d) for d in decs))
+        if rest <= {"int", "float"}:     # dec + float -> float
+            return "float"
+        raise PlanError(f"no common type for {sorted(s)}")
     if s <= {"int", "float"}:
         return "float"
     if s <= {"int", "date"}:
@@ -1526,12 +1570,35 @@ def _fold_cast_literal(e: P.BLit, target: str) -> P.BLit:
     if target == "date" and isinstance(v, str):
         return P.BLit("date", _date_to_days(v))
     if target == "float":
+        if is_dec(e.dtype):
+            return P.BLit("float", v / 10 ** dec_scale(e.dtype))
         return P.BLit("float", float(v))
     if target == "int":
+        if is_dec(e.dtype):
+            # integer truncation toward zero, matching the runtime cast
+            # (float division would round above 2^53)
+            s = 10 ** dec_scale(e.dtype)
+            return P.BLit("int", (1 if v >= 0 else -1) * (abs(int(v)) // s))
         return P.BLit("int", int(v))
     if target == "str":
         return P.BLit("str", str(v))
+    if is_dec(target):
+        # decN literal value convention: the ALREADY-SCALED integer
+        import decimal
+        src = decimal.Decimal(v).scaleb(-dec_scale(e.dtype)) \
+            if is_dec(e.dtype) else decimal.Decimal(str(v))
+        scaled = int(src.scaleb(dec_scale(target)).to_integral_value(
+            rounding=decimal.ROUND_HALF_UP))
+        return P.BLit(target, scaled)
     return P.BLit(target, v)
+
+
+def _dec_representable(v, scale: int) -> bool:
+    """Is literal v exact at decimal scale (Decimal-based: float math would
+    report 1.1*100 != 110)?"""
+    import decimal
+    d = decimal.Decimal(str(v)).scaleb(scale)
+    return d == d.to_integral_value()
 
 
 def _coerce_pair(a: P.BExpr, b: P.BExpr) -> tuple[P.BExpr, P.BExpr]:
@@ -1542,6 +1609,22 @@ def _coerce_pair(a: P.BExpr, b: P.BExpr) -> tuple[P.BExpr, P.BExpr]:
         return a, P.BLit("date", _date_to_days(b.value))
     if b.dtype == "date" and isinstance(a, P.BLit) and a.dtype == "str":
         return P.BLit("date", _date_to_days(a.value)), b
+    # decimal alignment: dec vs dec/int stays exact on scaled integers;
+    # dec vs float literal folds the literal to the decimal scale when it is
+    # exactly representable there, else both sides go to float
+    da, db = is_dec(a.dtype), is_dec(b.dtype)
+    if da or db:
+        if da and db:
+            t = dec_dtype(max(dec_scale(a.dtype), dec_scale(b.dtype)))
+            return _coerce_to(a, t), _coerce_to(b, t)
+        dec_e, other = (a, b) if da else (b, a)
+        t = dec_e.dtype
+        if other.dtype == "int" or (
+                isinstance(other, P.BLit) and other.dtype == "float"
+                and other.value is not None
+                and _dec_representable(other.value, dec_scale(t))):
+            return _coerce_to(a, t), _coerce_to(b, t)
+        return _coerce_to(a, "float"), _coerce_to(b, "float")
     # numeric widening
     if {a.dtype, b.dtype} <= {"int", "float"}:
         return _coerce_to(a, "float"), _coerce_to(b, "float")
@@ -1568,6 +1651,15 @@ def _arith_dtype(op: str, a: P.BExpr, b: P.BExpr) -> str:
         if a.dtype == "date" and b.dtype == "date":
             return "int"
         return "date"
+    da, db = is_dec(a.dtype), is_dec(b.dtype)
+    if da or db:
+        if a.dtype == "float" or b.dtype == "float" or op == "mod":
+            return "float"
+        if op == "mul":    # scaled-int product: scales add; dec*int keeps s
+            return dec_dtype((dec_scale(a.dtype) if da else 0) +
+                             (dec_scale(b.dtype) if db else 0))
+        # add/sub arrive scale-aligned from _coerce_pair
+        return a.dtype if da else b.dtype
     if a.dtype == "float" or b.dtype == "float":
         return "float"
     return "int"

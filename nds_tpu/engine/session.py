@@ -77,14 +77,23 @@ class Session:
             self._jax_exec_gen = self._generation
         return self._jax_exec
 
+    def _dec_as_int(self) -> bool:
+        """decimal_physical="i64": decimal columns load as exact scaled
+        int64 ("decN" logical dtype) instead of f64 (SURVEY.md §7 scaled-
+        int64 decimal plan; reference DecimalType, nds/nds_schema.py:43-47).
+        """
+        return self.config.decimal_physical == "i64"
+
     # -- registration -------------------------------------------------------
     def register_arrow(self, name: str, table: pa.Table,
                        est_rows: Optional[int] = None) -> None:
-        names, dtypes = arrow_bridge.engine_schema(table.schema)
+        dec = self._dec_as_int()
+        names, dtypes = arrow_bridge.engine_schema(table.schema, dec)
         self._schemas[name] = (names, dtypes)
         self._est_rows[name] = est_rows if est_rows is not None else table.num_rows
-        self._loaders[name] = lambda columns=None, t=table: \
-            arrow_bridge.from_arrow(t.select(list(columns)) if columns else t)
+        self._loaders[name] = lambda columns=None, t=table, dec=dec: \
+            arrow_bridge.from_arrow(t.select(list(columns)) if columns else t,
+                                    dec)
 
         def batches(columns, t=table):
             yield t.select(list(columns)) if columns else t
@@ -98,15 +107,16 @@ class Session:
         dataset = pa_dataset.dataset(path, format="parquet",
                                      partitioning="hive")
         schema = dataset.schema
-        names, dtypes = arrow_bridge.engine_schema(schema)
+        dec = self._dec_as_int()
+        names, dtypes = arrow_bridge.engine_schema(schema, dec)
         self._schemas[name] = (names, dtypes)
         if est_rows is None:
             est_rows = dataset.count_rows()
         self._est_rows[name] = est_rows
 
-        def load(columns=None, ds=dataset):
+        def load(columns=None, ds=dataset, dec=dec):
             cols = list(columns) if columns is not None else None
-            return arrow_bridge.from_arrow(ds.to_table(columns=cols))
+            return arrow_bridge.from_arrow(ds.to_table(columns=cols), dec)
         self._loaders[name] = load
 
         def batches(columns, ds=dataset):
@@ -126,11 +136,12 @@ class Session:
 
         files = ([os.path.join(path, f) for f in sorted(os.listdir(path))]
                  if os.path.isdir(path) else [path])
-        names, dtypes = arrow_bridge.engine_schema(schema)
+        dec = self._dec_as_int()
+        names, dtypes = arrow_bridge.engine_schema(schema, dec)
         self._schemas[name] = (names, dtypes)
         self._est_rows[name] = est_rows if est_rows is not None else 10000
 
-        def load(columns=None, files=tuple(files), schema=schema):
+        def load(columns=None, files=tuple(files), schema=schema, dec=dec):
             convert = pa_csv.ConvertOptions(
                 column_types={f.name: f.type for f in schema},
                 null_values=[""], strings_can_be_null=True,
@@ -141,7 +152,7 @@ class Session:
                                      parse_options=parse,
                                      convert_options=convert)
                      for f in files if os.path.getsize(f) > 0]
-            return arrow_bridge.from_arrow(pa.concat_tables(parts))
+            return arrow_bridge.from_arrow(pa.concat_tables(parts), dec)
         self._loaders[name] = load
 
         def batches(columns, files=tuple(files), schema=schema):
@@ -218,7 +229,7 @@ class Session:
         else:  # fallback: full load, sliced (correct, not memory-bounded)
             batches = [arrow_bridge.to_arrow(self.load_table(name, columns))]
         for part in emit(batches):
-            yield arrow_bridge.from_arrow(part)
+            yield arrow_bridge.from_arrow(part, self._dec_as_int())
 
     def load_table(self, name: str, columns=None) -> Table:
         """Load a table, optionally projected to `columns` (scan pruning:
@@ -241,7 +252,8 @@ class Session:
     # -- query --------------------------------------------------------------
     def _catalog(self) -> Catalog:
         return Catalog({name: (sch[0], sch[1], self._est_rows.get(name, 1000))
-                        for name, sch in self._schemas.items()})
+                        for name, sch in self._schemas.items()},
+                       dec_enabled=self._dec_as_int())
 
     def sql(self, query: str, backend: Optional[str] = None) -> Table:
         """Run a query; backend "jax" (device) or "numpy" (host oracle).
@@ -360,7 +372,7 @@ class Session:
         if not partials:
             return None  # empty source: the in-core path handles it
         merged_arrow = pa.concat_tables(partials, promote_options="permissive")
-        merged = arrow_bridge.from_arrow(merged_arrow)
+        merged = arrow_bridge.from_arrow(merged_arrow, self._dec_as_int())
         from .plan import MaterializedNode
         mat = MaterializedNode(table=merged, label="streamed-partials",
                                out_names=list(sp.partial_names),

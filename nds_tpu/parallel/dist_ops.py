@@ -46,10 +46,17 @@ def shard_rows(arrays: list[jax.Array], alive: jax.Array, mesh: Mesh
     return [pad(a) for a in arrays], pad(alive)
 
 
-def _fold_hash(key: jax.Array, n_shards: int) -> jax.Array:
-    """Deterministic shard assignment (Knuth multiplicative hash)."""
-    h = (key.astype(jnp.uint32) * jnp.uint32(2654435761)) >> 16
+def _multi_hash(keys: list[jax.Array], n_shards: int) -> jax.Array:
+    """Shard assignment over a composite key (mix-fold each column)."""
+    h = jnp.zeros(keys[0].shape, jnp.uint32)
+    for k in keys:
+        h = h * jnp.uint32(1000003) + (k.astype(jnp.uint32)
+                                       * jnp.uint32(2654435761) >> 13)
     return (h % jnp.uint32(n_shards)).astype(_I32)
+
+
+def _as_key_list(key) -> list[jax.Array]:
+    return list(key) if isinstance(key, (list, tuple)) else [key]
 
 
 def repartition_by_key(mesh: Mesh, per_pair_capacity: int):
@@ -59,13 +66,17 @@ def repartition_by_key(mesh: Mesh, per_pair_capacity: int):
     pytree with every row now living on shard hash(key) % n_shards, plus an
     int32 overflow counter (rows dropped because a (src,dst) block exceeded
     per_pair_capacity; callers must size capacity so this stays 0).
+    `key` may be one array or a list of arrays (composite shuffle key: the
+    hash mixes every column, the returned key is the first).
     """
     axis = mesh.axis_names[0]
     n_shards = mesh.devices.size
 
     def local(cols, alive, key):
+        keys = _as_key_list(key)
         cap = alive.shape[0]
-        dest = jnp.where(alive, _fold_hash(key, n_shards), n_shards)
+        dest = jnp.where(alive, _multi_hash(keys, n_shards), n_shards)
+        key = keys[0]
         # rank of each row within its destination block
         order = jnp.argsort(dest, stable=True)
         dest_sorted = dest[order]
@@ -108,69 +119,83 @@ def repartition_by_key(mesh: Mesh, per_pair_capacity: int):
                      out_specs=(P(axis), P(axis), P(axis), P()))
 
 
-def distributed_aggregate(mesh: Mesh, n_partial: int, specs: list[str]):
+def _partial_agg(spec: str, v, contrib, gid, n_partial):
+    sg = jnp.where(contrib, gid, n_partial)
+    if spec == "count":
+        return jax.ops.segment_sum(jnp.where(contrib, 1, 0).astype(v.dtype),
+                                   sg, num_segments=n_partial)
+    if spec == "sum":
+        return jax.ops.segment_sum(jnp.where(contrib, v, 0), sg,
+                                   num_segments=n_partial)
+    if spec in ("min", "max"):
+        ext = kernels._extreme(v.dtype, spec)
+        seg = jax.ops.segment_min if spec == "min" else jax.ops.segment_max
+        return seg(jnp.where(contrib, v, ext), sg, num_segments=n_partial)
+    raise ValueError(spec)
+
+
+def _merge_agg(spec: str, p, g_alive, m_gid, cap_out):
+    sg = jnp.where(g_alive, m_gid, cap_out)
+    if spec in ("sum", "count"):
+        return jax.ops.segment_sum(jnp.where(g_alive, p, 0), sg,
+                                   num_segments=cap_out)
+    ext = kernels._extreme(p.dtype, spec)
+    seg = jax.ops.segment_min if spec == "min" else jax.ops.segment_max
+    return seg(jnp.where(g_alive, p, ext), sg, num_segments=cap_out)
+
+
+def distributed_aggregate(mesh: Mesh, n_partial: int, specs: list[str],
+                          n_keys: int = 1):
     """Partial-aggregate per shard, all_gather bounded partials, final merge.
 
     specs: per-value aggregation kind, "sum"|"count"|"min"|"max".
-    Returned jittable fn: (group_key [sharded], valid, alive, values) ->
-    (group_keys [n_partial * n_shards], agg_values, out_alive, overflow)
-    replicated; overflow counts rows in groups beyond n_partial (callers
-    must size n_partial so it stays 0 — otherwise results are partial).
+    Returned jittable fn: (group_keys [sharded; one array or a list of
+    n_keys arrays — composite GROUP BY], valid (same shape), alive, values)
+    -> (group_keys [list, n_partial * n_shards each], agg_values, out_alive,
+    overflow) replicated; overflow counts rows in groups beyond n_partial
+    (callers must size n_partial so it stays 0 — otherwise results are
+    partial). Single-key callers get a single key array back.
     """
     axis = mesh.axis_names[0]
 
-    def local(key, valid, alive, values):
-        gid, _ = kernels.dense_rank([key], [valid], alive)
+    def local(keys, valids, alive, values):
+        keys, valids = _as_key_list(keys), _as_key_list(valids)
+        single = len(keys) == 1
+        gid, _ = kernels.dense_rank(keys, valids, alive)
         cap = alive.shape[0]
         # rows in groups beyond the partial capacity would be silently
         # dropped by the out-of-range scatter — count them instead
         overflow = jnp.sum((alive & (gid >= n_partial) & (gid < cap))
                            .astype(_I32))
-        reps, rep_valid = kernels.group_representatives(
-            gid, alive, key, valid, n_partial)
-        partials = []
-        for spec, v in zip(specs, values):
-            if spec == "count":
-                data = jnp.where(alive & valid, 1, 0).astype(v.dtype)
-                partials.append(jax.ops.segment_sum(
-                    data, jnp.where(alive, gid, n_partial),
-                    num_segments=n_partial))
-            elif spec == "sum":
-                data = jnp.where(alive & valid, v, 0)
-                partials.append(jax.ops.segment_sum(
-                    data, jnp.where(alive, gid, n_partial),
-                    num_segments=n_partial))
-            elif spec in ("min", "max"):
-                ext = kernels._extreme(v.dtype, spec)
-                data = jnp.where(alive & valid, v, ext)
-                seg = jax.ops.segment_min if spec == "min" \
-                    else jax.ops.segment_max
-                partials.append(seg(data, jnp.where(alive, gid, n_partial),
-                                    num_segments=n_partial))
-            else:
-                raise ValueError(spec)
-        group_alive = rep_valid  # a slot is used iff some row scattered into it
+        reps = []
+        rep_alive = None
+        for k, kv in zip(keys, valids):
+            r, ra = kernels.group_representatives(gid, alive, k, kv,
+                                                  n_partial)
+            reps.append(r)
+            rep_alive = ra if rep_alive is None else rep_alive
+        contrib = alive
+        for kv in valids:
+            contrib = contrib & kv
+        partials = [_partial_agg(spec, v, contrib, gid, n_partial)
+                    for spec, v in zip(specs, values)]
         # gather all shards' partials everywhere, merge locally (replicated)
-        g_keys = lax.all_gather(reps, axis, tiled=True)
-        g_alive = lax.all_gather(group_alive, axis, tiled=True)
+        g_keys = [lax.all_gather(r, axis, tiled=True) for r in reps]
+        g_alive = lax.all_gather(rep_alive, axis, tiled=True)
         g_partials = [lax.all_gather(p, axis, tiled=True) for p in partials]
-        m_gid, _ = kernels.dense_rank([g_keys], [g_alive], g_alive)
-        cap_out = g_keys.shape[0]
-        out_keys, out_alive = kernels.group_representatives(
-            m_gid, g_alive, g_keys, g_alive, cap_out)
-        merged = []
-        for spec, p in zip(specs, g_partials):
-            sg = jnp.where(g_alive, m_gid, cap_out)
-            if spec in ("sum", "count"):
-                merged.append(jax.ops.segment_sum(
-                    jnp.where(g_alive, p, 0), sg, num_segments=cap_out))
-            else:
-                ext = kernels._extreme(p.dtype, spec)
-                seg = jax.ops.segment_min if spec == "min" \
-                    else jax.ops.segment_max
-                merged.append(seg(jnp.where(g_alive, p, ext), sg,
-                                  num_segments=cap_out))
-        return out_keys, merged, out_alive, lax.psum(overflow, axis)
+        m_gid, _ = kernels.dense_rank(g_keys, [g_alive] * len(g_keys),
+                                      g_alive)
+        cap_out = g_keys[0].shape[0]
+        out_keys, out_alive = [], None
+        for gk in g_keys:
+            ok, oa = kernels.group_representatives(m_gid, g_alive, gk,
+                                                   g_alive, cap_out)
+            out_keys.append(ok)
+            out_alive = oa
+        merged = [_merge_agg(spec, p, g_alive, m_gid, cap_out)
+                  for spec, p in zip(specs, g_partials)]
+        keys_out = out_keys[0] if single else out_keys
+        return keys_out, merged, out_alive, lax.psum(overflow, axis)
 
     return shard_map(local, mesh=mesh,
                      in_specs=(P(axis), P(axis), P(axis), P(axis)),
@@ -182,14 +207,16 @@ def broadcast_join_aggregate(mesh: Mesh, n_partial: int, specs: list[str]):
 
     Sharded fact side (probe), replicated dimension side (build, unique
     keys assumed — PK side), filter mask applied, inner-join semantics,
-    grouped partial aggregation by a dimension attribute, psum-free
-    all_gather merge. This is the TPU-native shape of NDS power-run
-    queries (fact x dims -> group -> agg; e.g. reference query templates
-    joining store_sales to date_dim/item, SURVEY.md §0).
+    grouped partial aggregation by one or more dimension attributes,
+    psum-free all_gather merge. This is the TPU-native shape of NDS
+    power-run queries (fact x dims -> group -> agg; e.g. reference query
+    templates joining store_sales to date_dim/item, SURVEY.md §0).
 
+    specs: per-value "sum"|"count"|"min"|"max".
     Returned jittable fn:
       (fact_key, fact_mask, fact_alive, fact_values,
-       dim_key, dim_group, dim_alive) ->
+       dim_key, dim_group [one array or a list — composite GROUP BY],
+       dim_alive) ->
       (group_keys, agg_values, out_alive, overflow) replicated; overflow
       counts rows in groups beyond n_partial (must be 0 for exact results).
     """
@@ -197,6 +224,8 @@ def broadcast_join_aggregate(mesh: Mesh, n_partial: int, specs: list[str]):
 
     def local(fact_key, fact_mask, fact_alive, fact_values,
               dim_key, dim_group, dim_alive):
+        groups = _as_key_list(dim_group)
+        single = not isinstance(dim_group, (list, tuple))
         alive = fact_alive & fact_mask
         # build: sort replicated dim keys once (same on every shard)
         rcap = dim_key.shape[0]
@@ -206,35 +235,35 @@ def broadcast_join_aggregate(mesh: Mesh, n_partial: int, specs: list[str]):
         idx = jnp.searchsorted(sorted_key, fact_key)
         idx = jnp.clip(idx, 0, rcap - 1)
         matched = (sorted_key[idx] == fact_key) & alive
-        grp = dim_group[perm[idx]]
-        gid, _ = kernels.dense_rank([grp], [matched], matched)
+        grps = [g[perm[idx]] for g in groups]
+        gid, _ = kernels.dense_rank(grps, [matched] * len(grps), matched)
         cap = matched.shape[0]
         overflow = jnp.sum((matched & (gid >= n_partial) & (gid < cap))
                            .astype(_I32))
-        reps, rep_alive = kernels.group_representatives(
-            gid, matched, grp, matched, n_partial)
-        partials = []
-        for spec, v in zip(specs, fact_values):
-            sg = jnp.where(matched, gid, n_partial)
-            if spec == "count":
-                partials.append(jax.ops.segment_sum(
-                    jnp.where(matched, 1, 0).astype(v.dtype), sg,
-                    num_segments=n_partial))
-            else:
-                partials.append(jax.ops.segment_sum(
-                    jnp.where(matched, v, 0), sg, num_segments=n_partial))
-        g_keys = lax.all_gather(reps, axis, tiled=True)
+        reps, rep_alive = [], None
+        for grp in grps:
+            r, ra = kernels.group_representatives(gid, matched, grp,
+                                                  matched, n_partial)
+            reps.append(r)
+            rep_alive = ra if rep_alive is None else rep_alive
+        partials = [_partial_agg(spec, v, matched, gid, n_partial)
+                    for spec, v in zip(specs, fact_values)]
+        g_keys = [lax.all_gather(r, axis, tiled=True) for r in reps]
         g_alive = lax.all_gather(rep_alive, axis, tiled=True)
         g_partials = [lax.all_gather(p, axis, tiled=True) for p in partials]
-        m_gid, _ = kernels.dense_rank([g_keys], [g_alive], g_alive)
-        cap_out = g_keys.shape[0]
-        out_keys, out_alive = kernels.group_representatives(
-            m_gid, g_alive, g_keys, g_alive, cap_out)
-        merged = [jax.ops.segment_sum(jnp.where(g_alive, p, 0),
-                                      jnp.where(g_alive, m_gid, cap_out),
-                                      num_segments=cap_out)
-                  for p in g_partials]
-        return out_keys, merged, out_alive, lax.psum(overflow, axis)
+        m_gid, _ = kernels.dense_rank(g_keys, [g_alive] * len(g_keys),
+                                      g_alive)
+        cap_out = g_keys[0].shape[0]
+        out_keys, out_alive = [], None
+        for gk in g_keys:
+            ok, oa = kernels.group_representatives(m_gid, g_alive, gk,
+                                                   g_alive, cap_out)
+            out_keys.append(ok)
+            out_alive = oa
+        merged = [_merge_agg(spec, p, g_alive, m_gid, cap_out)
+                  for spec, p in zip(specs, g_partials)]
+        keys_out = out_keys[0] if single else out_keys
+        return keys_out, merged, out_alive, lax.psum(overflow, axis)
 
     return shard_map(local, mesh=mesh,
                      in_specs=(P(axis), P(axis), P(axis), P(axis),

@@ -48,9 +48,15 @@ def _read_output(path: str):
 
 
 def compare(expected, actual, epsilon: float = DEFAULT_EPSILON) -> bool:
-    """Scalar compare with float epsilon and NaN == NaN (ref :194-215)."""
+    """Scalar compare with float epsilon and NaN == NaN (ref :194-215);
+    Decimal (scaled-int64 decimal outputs) compares under the same epsilon
+    as float, matching the reference's Decimal handling (ref :203-210)."""
+    import decimal
     if expected is None or actual is None:
         return expected is None and actual is None
+    if isinstance(expected, decimal.Decimal) or \
+            isinstance(actual, decimal.Decimal):
+        expected, actual = float(expected), float(actual)
     if isinstance(expected, float) or isinstance(actual, float):
         fe, fa = float(expected), float(actual)
         if math.isnan(fe) or math.isnan(fa):

@@ -244,14 +244,15 @@ class Warehouse:
             if not files:
                 continue
             dataset = pa_dataset.dataset(files, format="parquet")
-            names, dtypes = arrow_bridge.engine_schema(dataset.schema)
+            dec = session._dec_as_int()
+            names, dtypes = arrow_bridge.engine_schema(dataset.schema, dec)
             session._schemas[name] = (names, dtypes)
             session._est_rows[name] = (est_rows or {}).get(
                 name, dataset.count_rows())
 
-            def load(columns=None, ds=dataset):
+            def load(columns=None, ds=dataset, dec=dec):
                 cols = list(columns) if columns is not None else None
-                return arrow_bridge.from_arrow(ds.to_table(columns=cols))
+                return arrow_bridge.from_arrow(ds.to_table(columns=cols), dec)
             session._loaders[name] = load
 
             def batches(columns, ds=dataset):

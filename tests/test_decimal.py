@@ -1,0 +1,238 @@
+"""Scaled-int64 decimal ("decN") tests.
+
+decimal_physical="i64" stores DECIMAL(p,s) columns as value*10^s int64 —
+exact sums/compares on integers, float only at division points (SURVEY.md §7
+scaled-int64 decimal plan; the reference keeps DecimalType end-to-end,
+nds/nds_schema.py:43-47). Covers both backends plus the use_decimal=True
+end-to-end run the round-1 verdict asked for.
+"""
+import decimal
+
+import pyarrow as pa
+import pytest
+
+from nds_tpu.config import EngineConfig
+from nds_tpu.engine import Session
+from nds_tpu.engine.column import dec_dtype, dec_scale, is_dec
+
+D = decimal.Decimal
+
+
+def dec_table() -> pa.Table:
+    return pa.table({
+        "k": pa.array([1, 1, 2, 2, 2, 3]),
+        "p": pa.array([D("1.10"), D("2.25"), None, D("0.05"), D("-3.33"),
+                       D("7.00")], type=pa.decimal128(7, 2)),
+        "q": pa.array([2, 3, 1, 4, 2, 5]),
+        "f": pa.array([0.5, 1.5, 2.5, 3.5, 4.5, 5.5]),
+    })
+
+
+@pytest.fixture(scope="module", params=["numpy", "jax"])
+def backend(request):
+    return request.param
+
+
+@pytest.fixture(scope="module")
+def dec_session():
+    s = Session(EngineConfig(decimal_physical="i64"))
+    s.register_arrow("t", dec_table())
+    return s
+
+
+def rows(t):
+    return t.to_pylist()
+
+
+def test_dec_dtype_helpers():
+    assert is_dec("dec2") and is_dec("dec0")
+    assert not is_dec("decimal") and not is_dec("int") and not is_dec("dec")
+    assert dec_scale("dec2") == 2
+    assert dec_dtype(4) == "dec4"
+
+
+def test_scan_is_scaled_int(dec_session):
+    t = dec_session.load_table("t")
+    col = t.columns[t.names.index("p")]
+    assert col.dtype == "dec2"
+    assert col.data.dtype.kind == "i"
+    assert col.data[0] == 110  # 1.10 -> 110
+
+
+def test_exact_aggregates(dec_session, backend):
+    r = dec_session.sql(
+        "SELECT k, SUM(p) AS sp, AVG(p) AS ap, MIN(p) AS mn, MAX(p) AS mx, "
+        "COUNT(p) AS c FROM t GROUP BY k ORDER BY k", backend=backend)
+    assert dec_session.last_fallbacks == []
+    got = rows(r)
+    assert got[0] == (1, D("3.35"), 1.675, D("1.10"), D("2.25"), 2)
+    assert got[1] == (2, D("-3.28"), pytest.approx(-1.64), D("-3.33"),
+                      D("0.05"), 2)
+    assert got[2] == (3, D("7.00"), 7.0, D("7.00"), D("7.00"), 1)
+
+
+def test_exactness_beyond_float32(backend):
+    """Sums that would round in f32 (2^24 cutoff) stay exact as scaled ints."""
+    n = 60000
+    vals = [D("167772.16")] * n          # scaled: 16777216 = 2^24 each
+    s = Session(EngineConfig(decimal_physical="i64"))
+    s.register_arrow("big", pa.table({
+        "v": pa.array(vals, type=pa.decimal128(18, 2))}))
+    r = s.sql("SELECT SUM(v) AS sv FROM big", backend=backend)
+    assert rows(r)[0][0] == D("167772.16") * n
+
+
+def test_mixed_arithmetic(dec_session, backend):
+    r = dec_session.sql(
+        "SELECT SUM(p * q) AS spq, SUM(p + 1) AS sp1, SUM(p - p) AS zero, "
+        "SUM(p * p) AS spp, SUM(p / q) AS ratio FROM t", backend=backend)
+    got = rows(r)[0]
+    assert got[0] == D("37.49")      # exact dec2 * int
+    assert got[1] == D("12.07")      # 7.07 + 5x1 (int literal scaled)
+    assert got[2] == D("0.00")
+    assert got[3] == D("66.3639")    # dec2*dec2 -> dec4, exact
+    assert got[4] == pytest.approx(1.10 / 2 + 2.25 / 3 + 0.05 / 4
+                                   - 3.33 / 2 + 7.0 / 5)
+
+
+def test_compare_and_in_list(dec_session, backend):
+    r = dec_session.sql(
+        "SELECT COUNT(*) AS c FROM t WHERE p > 0.04 AND p <= 2.25",
+        backend=backend)
+    assert rows(r)[0][0] == 3        # 1.10, 2.25, 0.05
+    r = dec_session.sql(
+        "SELECT COUNT(*) AS c FROM t WHERE p IN (1.10, 7.00, 9.99)",
+        backend=backend)
+    assert rows(r)[0][0] == 2
+    r = dec_session.sql(          # non-representable literal can never match
+        "SELECT COUNT(*) AS c FROM t WHERE p IN (1.105)", backend=backend)
+    assert rows(r)[0][0] == 0
+
+
+def test_dec_float_interplay(dec_session, backend):
+    r = dec_session.sql(
+        "SELECT SUM(p * f) AS pf, COUNT(CASE WHEN p > f THEN 1 END) AS c "
+        "FROM t", backend=backend)
+    got = rows(r)[0]
+    assert got[0] == pytest.approx(1.10 * 0.5 + 2.25 * 1.5 + 0.05 * 3.5
+                                   - 3.33 * 4.5 + 7.0 * 5.5)
+    assert got[1] == 3               # 1.10>0.5, 2.25>1.5, 7.00>5.5
+
+
+def test_casts(dec_session, backend):
+    r = dec_session.sql(
+        "SELECT CAST(p AS INT) AS pi, CAST(p AS DOUBLE) AS pf, "
+        "CAST(q AS DECIMAL(7,2)) AS qd, ROUND(p, 1) AS p1, "
+        "CAST(p AS DECIMAL(7,1)) AS pr FROM t WHERE p IS NOT NULL "
+        "ORDER BY p", backend=backend)
+    got = rows(r)
+    # ordered by p: -3.33, 0.05, 1.10, 2.25, 7.00
+    assert [g[0] for g in got] == [-3, 0, 1, 2, 7]      # truncate toward 0
+    assert got[2][1] == pytest.approx(1.10)
+    assert got[0][2] == D("2.00")                        # q=2 -> 2.00
+    assert [g[3] for g in got] == [D("-3.3"), D("0.1"), D("1.1"),
+                                   D("2.3"), D("7.0")]   # half-up
+    assert [g[4] for g in got] == [D("-3.3"), D("0.1"), D("1.1"),
+                                   D("2.3"), D("7.0")]
+
+
+def test_windows_over_dec(dec_session, backend):
+    r = dec_session.sql(
+        "SELECT k, p, SUM(p) OVER (PARTITION BY k ORDER BY p) AS rs, "
+        "RANK() OVER (ORDER BY p) AS rk FROM t WHERE p IS NOT NULL "
+        "ORDER BY k, p", backend=backend)
+    got = rows(r)
+    assert got[0][2] == D("1.10") and got[1][2] == D("3.35")
+    assert got[2][2] == D("-3.33") and got[3][2] == D("-3.28")
+
+
+def test_dec_group_key_and_join(dec_session, backend):
+    r = dec_session.sql(
+        "SELECT p, COUNT(*) AS c FROM t WHERE p IS NOT NULL GROUP BY p "
+        "ORDER BY p", backend=backend)
+    assert len(rows(r)) == 5
+    r = dec_session.sql(
+        "SELECT a.k, b.p FROM t a JOIN t b ON a.p = b.p WHERE a.k = 3",
+        backend=backend)
+    assert rows(r) == [(3, D("7.00"))]
+
+
+def test_round_negative_digits(dec_session, backend):
+    s = Session(EngineConfig(decimal_physical="i64"))
+    s.register_arrow("h", pa.table({
+        "v": pa.array([D("12345.78"), D("-250.00")],
+                      type=pa.decimal128(9, 2))}))
+    r = s.sql("SELECT ROUND(v, -2) AS r FROM h", backend=backend)
+    assert [v for (v,) in rows(r)] == [D("12300"), D("-300")]
+
+
+def test_out_of_core_decimal_streaming():
+    """Out-of-core morsels must load decimals as scaled ints too (the
+    compiled morsel plan expects decN columns)."""
+    n = 5000
+    t = pa.table({
+        "k": pa.array([i % 3 for i in range(n)]),
+        "p": pa.array([D("1.25")] * n, type=pa.decimal128(7, 2)),
+    })
+    s = Session(EngineConfig(decimal_physical="i64", out_of_core=True,
+                             chunk_rows=512))
+    s.register_arrow("t", t, est_rows=n)
+    s._est_rows["t"] = n
+    r = s.sql("SELECT k, SUM(p) AS sp, COUNT(*) AS c FROM t GROUP BY k "
+              "ORDER BY k")
+    got = rows(r)
+    assert s.last_exec_stats.get("mode") == "streaming"
+    for k, sp, c in got:
+        assert sp == D("1.25") * c
+
+
+def test_setop_aligns_decimal_scales(dec_session, backend):
+    # p is dec2; p*p is dec4 — the union must rescale, never concat raw ints
+    r = dec_session.sql(
+        "SELECT p FROM t WHERE k = 3 UNION ALL "
+        "SELECT p * p FROM t WHERE k = 3", backend=backend)
+    vals = sorted(v for (v,) in rows(r))
+    assert vals == [D("7.00"), D("49.00")] or vals == [D("7.0000"),
+                                                       D("49.0000")]
+
+
+def test_use_decimal_end_to_end(tmp_path):
+    """VERDICT item 7 done-criterion: use_decimal=True datagen -> transcode
+    -> power-style queries on the i64 session validate against the f64
+    oracle session under the validator epsilon."""
+    from nds_tpu import datagen, streams, transcode, validate
+    from nds_tpu.power import setup_tables
+    data = str(tmp_path / "data")
+    wh = str(tmp_path / "wh")
+    datagen.generate_data_local(data, 0.001, parallel=2, overwrite=True)
+    transcode.transcode(data, wh, str(tmp_path / "rep.txt"),
+                        use_decimal=True, partition=False)
+
+    s_dec = Session(EngineConfig(decimal_physical="i64"))
+    setup_tables(s_dec, wh, "parquet")
+    s_f64 = Session(EngineConfig())
+    setup_tables(s_f64, wh, "parquet")
+
+    def norm_rows(table):
+        # Decimal -> float so the sort key matches across physical types
+        out = []
+        for row in table.to_pylist():
+            out.append(tuple(float(v) if isinstance(v, D) else v
+                             for v in row))
+        key = lambda row: tuple((v is None, str(v)) for v in row
+                                if not isinstance(v, float))
+        return sorted(out, key=key)
+
+    for number in (3, 7, 42, 52, 55):
+        sql = streams.instantiate(number, stream=0, rngseed=31415)
+        expected = s_f64.sql(sql, backend="numpy")
+        actual = s_dec.sql(sql, backend="jax")
+        assert s_dec.last_fallbacks == [], \
+            f"query{number}: {s_dec.last_fallbacks}"
+        rows_e = norm_rows(expected)
+        rows_a = norm_rows(actual)
+        assert len(rows_e) == len(rows_a), f"query{number}"
+        for re_, ra_ in zip(rows_e, rows_a):
+            assert validate.row_equal(re_, ra_, f"query{number}",
+                                      list(expected.names)), \
+                f"query{number}: {re_} != {ra_}"

@@ -120,6 +120,64 @@ def test_broadcast_join_aggregate_matches_host(mesh):
         assert got[g][0] == pytest.approx(want[g][0], rel=1e-5)
 
 
+def test_distributed_aggregate_multi_key_minmax(mesh):
+    """Composite GROUP BY + min/max through the collective aggregate."""
+    rng = np.random.default_rng(7)
+    n = 1024
+    k1 = rng.integers(0, 7, n).astype(np.int32)
+    k2 = rng.integers(0, 5, n).astype(np.int32)
+    val = rng.integers(1, 1000, n).astype(np.int32)
+    (sk1, sk2, sval), salive = shard_rows(
+        [jnp.asarray(k1), jnp.asarray(k2), jnp.asarray(val)],
+        jnp.ones(n, bool), mesh)
+    ones = jnp.ones_like(salive)
+    fn = jax.jit(distributed_aggregate(mesh, n_partial=64,
+                                       specs=["min", "max", "sum"]))
+    out_keys, (mins, maxs, sums), out_alive, overflow = fn(
+        [sk1, sk2], [ones, ones], salive, [sval, sval, sval])
+    assert int(overflow) == 0
+    mask = np.asarray(out_alive)
+    got = {(int(a), int(b)): (int(m), int(x), int(s))
+           for a, b, m, x, s in zip(np.asarray(out_keys[0])[mask],
+                                    np.asarray(out_keys[1])[mask],
+                                    np.asarray(mins)[mask],
+                                    np.asarray(maxs)[mask],
+                                    np.asarray(sums)[mask])}
+    want = {}
+    for a, b, v in zip(k1, k2, val):
+        m, x, s = want.get((int(a), int(b)), (10**9, -10**9, 0))
+        want[(int(a), int(b))] = (min(m, int(v)), max(x, int(v)), s + int(v))
+    assert got == want
+
+
+def test_repartition_composite_key(mesh):
+    rng = np.random.default_rng(9)
+    n = 512
+    k1 = jnp.asarray(rng.integers(0, 50, n).astype(np.int32))
+    k2 = jnp.asarray(rng.integers(0, 3, n).astype(np.int32))
+    val = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    (sk1, sk2, sval), salive = shard_rows([k1, k2, val],
+                                          jnp.ones(n, bool), mesh)
+    fn = jax.jit(repartition_by_key(mesh, per_pair_capacity=96))
+    (ok1, ok2, oval), out_alive, out_key, overflow = fn(
+        [sk1, sk2, sval], salive, [sk1, sk2])
+    assert int(overflow) == 0
+    mask = np.asarray(out_alive)
+    # no rows lost; every composite key lands on exactly one shard
+    in_rows = sorted(zip(np.asarray(sk1)[np.asarray(salive)].tolist(),
+                         np.asarray(sk2)[np.asarray(salive)].tolist()))
+    out_rows = sorted(zip(np.asarray(ok1)[mask].tolist(),
+                          np.asarray(ok2)[mask].tolist()))
+    assert in_rows == out_rows
+    pair_shard = {}
+    k1s = np.asarray(ok1).reshape(8, -1)
+    k2s = np.asarray(ok2).reshape(8, -1)
+    ms = mask.reshape(8, -1)
+    for s in range(8):
+        for a, b in zip(k1s[s][ms[s]], k2s[s][ms[s]]):
+            assert pair_shard.setdefault((int(a), int(b)), s) == s
+
+
 # -- power-run subset over the mesh ------------------------------------------
 # Real NDS templates executed through Session.sql with mesh_shape=(8,):
 # GSPMD row-shards the fact scans and inserts the collectives, and the result

@@ -352,6 +352,28 @@ class JaxExecutor:
         rec.checks.append(jnp.asarray(scalar, _I32))
         return v
 
+    def _decide_exact_lazy(self, fn: Callable[[], jax.Array]) -> int:
+        """Exact decision whose traced scalar is computed lazily: when the
+        recorded value is falsy, replay skips the computation entirely and
+        checks a constant (one-sided verification — taking the general path
+        is always correct, so an ineligible-recorded fast path must not pay
+        its eligibility probe in the compiled program, nor force a
+        re-record when data drifts eligible-ward)."""
+        rec = self._rec
+        if rec is None:
+            return int(fn())
+        if rec.mode == "record":
+            v = int(fn())
+            rec.decisions.append(("exact", v))
+            return v
+        kind, v = rec.decisions[rec.idx]
+        rec.idx += 1
+        if kind != "exact":
+            raise NotJittable("decision kind drift (exact)")
+        rec.checks.append(jnp.asarray(fn(), _I32) if v
+                          else jnp.zeros((), _I32))
+        return v
+
     # -- helpers -------------------------------------------------------------
     def _eval(self, expr: BExpr, table: DTable) -> DCol:
         return jexprs.evaluate(expr, table, subquery_eval=self._scalar)
@@ -720,6 +742,20 @@ class JaxExecutor:
         for c in rkeys:
             rvalid = rvalid & c.valid
 
+        if len(lkeys) == 1 and kind in ("inner", "left", "semi", "anti"):
+            # direct-address fast path: the NDS star-join shape (single int
+            # key, unique build side with a bounded key range — dimension
+            # primary keys are dense). Replaces the sort-based machinery
+            # (dense_rank over lcap+rcap rows + build sort + expansion)
+            # with one scatter + gathers: TPU lax.sort is O(log^2 n) merge
+            # passes over every operand, the dominant HBM traffic of a
+            # power-run query program.
+            out = self._fast_join(node, left, right, lkeys[0], rkeys[0],
+                                  left.alive & lvalid, right.alive & rvalid,
+                                  lvalid, rvalid)
+            if out is not None:
+                return out
+
         key_data = []
         for lc, rc in zip(lkeys, rkeys):
             ld, rd = _joinable_pair(lc, rc)
@@ -786,6 +822,98 @@ class JaxExecutor:
             pieces.append(_null_extend_left(left, right, unmatched_r,
                                             names=list(node.out_names)))
         return _concat_dtables(pieces, list(node.out_names))
+
+    def _fast_join(self, node: JoinNode, left: DTable, right: DTable,
+                   lkey: DCol, rkey: DCol, l_ok: jax.Array, r_ok: jax.Array,
+                   lvalid: jax.Array, rvalid: jax.Array) -> Optional[DTable]:
+        """Direct-address single-key join against a unique build side.
+
+        Build: scatter build-row indices into a [LIMIT] table addressed by
+        (key - min_key). Probe: one gather + a key-equality confirm (which
+        also makes the path immune to range-arithmetic overflow). 1:1 match
+        means the output keeps the probe capacity — no expansion step, no
+        capacity decision, no sorts. Eligibility (unique keys, bounded
+        range) is data-dependent: decided at record time and replayed as an
+        exact schedule decision, so record and replay always take the same
+        branch.
+        """
+        kind = node.kind
+        lcap, rcap = left.capacity, right.capacity
+        ld, rd = _joinable_pair(lkey, rkey)
+        if not jnp.issubdtype(rd.dtype, jnp.integer):
+            return None    # float keys: no address arithmetic
+        limit = min(4 * rcap, 1 << 24)
+        big = jnp.iinfo(rd.dtype).max
+        small = jnp.iinfo(rd.dtype).min
+        state: dict = {}
+
+        def probe() -> jax.Array:
+            rmin = jnp.min(jnp.where(r_ok, rd, big))
+            rmax = jnp.max(jnp.where(r_ok, rd, small))
+            cnt_r = jnp.sum(r_ok.astype(_I32))
+            span_ok = (rmax - rmin) < limit
+            lut_idx = jnp.clip(rd - rmin, 0, limit - 1)
+            scatter_idx = jnp.where(r_ok, lut_idx, limit)
+            hist = jnp.zeros(limit + 1, _I32).at[scatter_idx].add(1)[:limit]
+            unique = jnp.max(hist) <= 1
+            state.update(rmin=rmin, scatter_idx=scatter_idx)
+            return (span_ok & unique & (cnt_r > 0)).astype(_I32)
+
+        if not self._decide_exact_lazy(probe):
+            return None
+        rmin, scatter_idx = state["rmin"], state["scatter_idx"]
+
+        lut = jnp.full(limit + 1, -1, _I32).at[scatter_idx].set(
+            jnp.arange(rcap, dtype=_I32))[:limit]
+        pidx = ld - rmin
+        in_range = (pidx >= 0) & (pidx < limit)
+        r_row = lut[jnp.clip(pidx, 0, limit - 1)]
+        safe_r = jnp.clip(r_row, 0, rcap - 1)
+        # key-equality confirm: correctness never rests on range arithmetic
+        matched = l_ok & in_range & (r_row >= 0) & (rd[safe_r] == ld)
+
+        if kind in ("semi", "anti") and node.residual is None:
+            if kind == "semi":
+                alive = left.alive & matched
+            elif node.null_aware:
+                build_has_null = bool(self._decide_exact(
+                    jnp.any(right.alive & ~rvalid)))
+                alive = jnp.zeros(lcap, bool) if build_has_null \
+                    else left.alive & lvalid & ~matched
+            else:
+                alive = left.alive & ~matched
+            return self._maybe_compact(
+                DTable(list(node.out_names), left.cols, alive))
+
+        rcols = [_gather_col(c, safe_r) for c in right.cols]
+        names = list(node.out_names) if len(node.out_names) == \
+            len(left.cols) + len(rcols) else \
+            [f"__c{i}" for i in range(len(left.cols) + len(rcols))]
+        combined = DTable(names, list(left.cols) + rcols, left.alive)
+        if node.residual is not None:
+            mask = jexprs.evaluate(node.residual, combined,
+                                   subquery_eval=self._scalar)
+            matched = matched & mask.data.astype(bool) & mask.valid
+
+        if kind == "semi":
+            return self._maybe_compact(DTable(
+                list(node.out_names), left.cols, left.alive & matched))
+        if kind == "anti":
+            return self._maybe_compact(DTable(
+                list(node.out_names), left.cols, left.alive & ~matched))
+        if kind == "inner":
+            return self._maybe_compact(DTable(
+                combined.names, combined.cols, left.alive & matched))
+        # left join: 1:1 — unmatched probe rows keep a NULL right side
+        out_cols = list(left.cols)
+        for c in rcols:
+            out_cols.append(DCol(c.dtype, c.data, c.valid & matched,
+                                 c.dictionary,
+                                 None if c.parts is None else tuple(
+                                     DCol(p.dtype, p.data,
+                                          p.valid & matched, p.dictionary)
+                                     for p in c.parts)))
+        return DTable(list(node.out_names), out_cols, left.alive)
 
     def _expand_combine(self, node: JoinNode, left: DTable, right: DTable,
                         lo, cnt, perm_r, residual=None

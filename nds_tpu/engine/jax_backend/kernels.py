@@ -41,6 +41,10 @@ def dense_rank(key_data: list[jax.Array], key_valid: list[jax.Array],
     == capacity (sentinel segment) for dead rows. Deterministic (sort-based).
     """
     n = alive.shape[0]
+    if not key_data:
+        # global group: every alive row is group 0 (no sort)
+        gid = jnp.where(alive, 0, n).astype(_I32)
+        return gid, jnp.any(alive).astype(_I32)
     operands: list[jax.Array] = [(~alive).astype(_I32)]
     for d, v in zip(key_data, key_valid):
         operands.append((~v).astype(_I32))
@@ -76,10 +80,15 @@ def filter_alive(alive: jax.Array, mask_data: jax.Array,
 
 
 def compaction_perm(alive: jax.Array) -> tuple[jax.Array, jax.Array]:
-    """Stable permutation bringing alive rows to the front; returns (perm, count)."""
+    """Stable permutation bringing alive rows to the front; returns
+    (perm, count). Scatter-based (cumsum positions), not a sort: TPU
+    lax.sort is O(log^2 n) merge passes and compaction runs after every
+    selective filter. Entries past `count` are unspecified valid indices
+    (callers mask by count)."""
     n = alive.shape[0]
-    dead = (~alive).astype(_I32)
-    _, perm = lax.sort((dead, _iota(n)), num_keys=1, is_stable=True)
+    pos = jnp.cumsum(alive.astype(_I32)) - 1
+    target = jnp.where(alive, pos, n)
+    perm = jnp.zeros(n + 1, _I32).at[target].set(_iota(n))[:n]
     return perm, jnp.sum(alive.astype(_I32))
 
 

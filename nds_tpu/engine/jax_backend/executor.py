@@ -905,14 +905,13 @@ class JaxExecutor:
             return self._maybe_compact(DTable(
                 combined.names, combined.cols, left.alive & matched))
         # left join: 1:1 — unmatched probe rows keep a NULL right side
-        out_cols = list(left.cols)
-        for c in rcols:
-            out_cols.append(DCol(c.dtype, c.data, c.valid & matched,
-                                 c.dictionary,
-                                 None if c.parts is None else tuple(
-                                     DCol(p.dtype, p.data,
-                                          p.valid & matched, p.dictionary)
-                                     for p in c.parts)))
+        # (canonical zeros under ~matched: DCol's null-payload invariant)
+        def null_out(c: DCol) -> DCol:
+            data = jnp.where(matched, c.data, jnp.zeros((), c.data.dtype))
+            return DCol(c.dtype, data, c.valid & matched, c.dictionary,
+                        None if c.parts is None else tuple(
+                            null_out(p) for p in c.parts))
+        out_cols = list(left.cols) + [null_out(c) for c in rcols]
         return DTable(list(node.out_names), out_cols, left.alive)
 
     def _expand_combine(self, node: JoinNode, left: DTable, right: DTable,

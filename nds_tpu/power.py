@@ -147,6 +147,7 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
                      warmup: int = 0,
                      strict: bool = False,
                      profile_folder: str | None = None,
+                     fault_inject: list[str] | None = None,
                      keep_sc: bool = False) -> list[tuple[str, int, int, int]]:
     """Run every query in the stream; returns (name, start_ms, end_ms, ms).
 
@@ -160,6 +161,11 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
     (the reference runs every op on the accelerator).
     profile_folder: write a jax.profiler trace per query under this folder
     (the Spark-UI job-group analog, reference nds_power.py:254).
+    fault_inject: query names whose timed run raises an injected fault —
+    a harness-testing hook (SURVEY.md §5 failure-detection item; the
+    reference only detects failures, it cannot inject them): the run must
+    record ``Failed`` with the exception in the JSON summary and keep
+    going, exactly like a genuine mid-stream query failure.
     """
     from .check import check_json_summary_folder, check_query_subset_exists
 
@@ -179,24 +185,34 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
 
     rows: list[tuple[str, int, int, int]] = []
     fallback_queries: dict[str, list[str]] = {}
+    inject = set(fault_inject or ())
     power_start = int(time.time() * 1000)
     for name, sql in query_dict.items():
         report = BenchReport(config, app_name=f"NDS-TPU {name}")
-        for _ in range(warmup):
-            try:
-                run_one_query(session, sql, name, None, output_format,
-                              backend)
-            except Exception:
-                break  # the timed run reports the failure
+        injected = name in inject or \
+            re.sub(r"_part[12]$", "", name) in inject
+        if injected:
+            session.last_fallbacks = []     # injected runs never reach the
+            session.last_exec_stats = {}    # session; don't report stale state
+            def run_fn(*_a, **_k):
+                raise RuntimeError(f"injected fault for {name}")
+        else:
+            run_fn = run_one_query
+            for _ in range(warmup):
+                try:
+                    run_one_query(session, sql, name, None, output_format,
+                                  backend)
+                except Exception:
+                    break  # the timed run reports the failure
         q_start = int(time.time() * 1000)
         if profile_folder:
             import jax
             os.makedirs(profile_folder, exist_ok=True)
             with jax.profiler.trace(os.path.join(profile_folder, name)):
-                report.report_on(run_one_query, session, sql, name,
+                report.report_on(run_fn, session, sql, name,
                                  output_prefix, output_format, backend)
         else:
-            report.report_on(run_one_query, session, sql, name,
+            report.report_on(run_fn, session, sql, name,
                              output_prefix, output_format, backend)
         for fb in session.last_fallbacks:
             report.record_task_failure(f"device fallback: {fb}")
@@ -249,13 +265,17 @@ def main(argv: list[str] | None = None) -> int:
                    help="fail if any query fell back to the host oracle")
     p.add_argument("--profile_folder", default=None,
                    help="write a jax.profiler trace per query here")
+    p.add_argument("--fault_inject", default=None,
+                   help="comma-separated query names whose run raises an "
+                        "injected fault (harness self-test)")
     a = p.parse_args(argv)
     sub = a.sub_queries.split(",") if a.sub_queries else None
+    inject = a.fault_inject.split(",") if a.fault_inject else None
     run_query_stream(a.input_prefix, a.query_stream_file, a.time_log,
                      a.input_format, a.output_prefix, a.output_format,
                      a.json_summary_folder, sub, a.property_file, a.backend,
                      warmup=a.warmup, strict=a.strict,
-                     profile_folder=a.profile_folder)
+                     profile_folder=a.profile_folder, fault_inject=inject)
     return 0
 
 

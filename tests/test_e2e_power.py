@@ -65,7 +65,34 @@ def test_power_run_and_validate(env):
     assert any(s.startswith("power-query1-") for s in summaries)
 
     # validation status written back into summaries
-    validate.update_summary(str(root / "json"), status)
     import json
+    validate.update_summary(str(root / "json"), status)
     with open(root / "json" / sorted(summaries)[0]) as f:
         assert json.load(f)["queryValidationStatus"] in (["Pass"],)
+
+
+def test_fault_injection_surfaces_failed_status(env):
+    """Harness self-test hook (SURVEY.md §5 failure-detection item): an
+    injected fault must record Failed with the exception in the JSON
+    summary and the stream must keep running."""
+    import glob
+    import json
+
+    root, data, stream = env
+    json_dir = str(root / "json_fault")
+    rows = run_query_stream(data, stream, str(root / "time_fault.csv"),
+                            input_format="csv", backend="numpy",
+                            json_summary_folder=json_dir,
+                            sub_queries=["query1", "query3"],
+                            fault_inject=["query1"])
+    assert [r[0] for r in rows] == ["query1", "query3"]
+    summaries = {}
+    for path in glob.glob(os.path.join(json_dir, "*.json")):
+        with open(path) as f:
+            d = json.load(f)
+        # filename contract: {prefix}-{query}-{startTime}.json
+        summaries[os.path.basename(path).split("-")[1]] = d
+    assert summaries["query1"]["queryStatus"] == ["Failed"]
+    assert any("injected fault" in e
+               for e in summaries["query1"]["exceptions"])
+    assert summaries["query3"]["queryStatus"] == ["Completed"]

@@ -49,8 +49,9 @@ def _chunked_to_array(arr: pa.ChunkedArray | pa.Array) -> pa.Array:
 def _decimal_to_scaled_i64(arr: pa.Array) -> np.ndarray:
     """Exact decimal128(p,s) -> value*10^s as int64 (no float round-trip)."""
     t = arr.type
-    # multiply result precision is p + (s+1) + 1; past 38 arrow refuses
-    if t.precision + t.scale + 2 <= 38:
+    # fast path only when every scaled value provably fits int64
+    # (10^18 < 2^63): the safe=False cast below would wrap silently
+    if t.precision <= 18:
         mul = pa.scalar(10 ** t.scale, pa.decimal128(t.scale + 1, 0))
         ints = pc.cast(pc.multiply(arr, mul), pa.int64(), safe=False)
         ints = pc.fill_null(ints, 0)

@@ -724,6 +724,12 @@ class JaxExecutor:
 
     def _join(self, node: JoinNode, left: DTable, right: DTable) -> DTable:
         kind = node.kind
+        # Every anti branch below consults null_aware only when residual is
+        # None; the combination is planner-rejected (planner.py _decorrelate)
+        # — assert so a future planner change can't silently keep rows that
+        # NOT IN semantics exclude.
+        assert not (node.null_aware and node.residual is not None), \
+            "null-aware anti join with residual is unsupported"
         lcap, rcap = left.capacity, right.capacity
         if kind == "cross":
             lo = jnp.zeros(lcap, _I32)

@@ -382,6 +382,12 @@ def join(left: Table, right: Table, kind: str,
     null_aware: NOT-IN semantics for anti joins — a NULL probe key or any NULL
     build key disqualifies (predicate is NULL, never TRUE).
     """
+    # The null-aware branch below tests build-side NULLs BEFORE the residual
+    # filter, which is wrong when a residual could exclude the NULL-key build
+    # rows; the planner guarantees the combination never reaches us
+    # (planner.py _decorrelate raises PlanError for it).
+    assert not (null_aware and residual_eval is not None), \
+        "null-aware anti join with residual is unsupported"
     if kind == "cross" or not left_keys:
         # keyless joins (pure theta: residual-only condition) are a filtered
         # cross product

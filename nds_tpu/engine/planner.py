@@ -1066,6 +1066,19 @@ class _Binder:
             v = b.value
             if e.dtype == "date" and b.dtype == "str":
                 v = _date_to_days(v)
+            if is_dec(b.dtype) and v is not None:
+                # executors expect LOGICAL in-list values (they re-scale to
+                # the probed column's scale); dec BLits hold scaled ints.
+                # Dec-typed probes keep exact Decimals (_scaled_in_values
+                # round-trips str(Decimal) losslessly); float probes get
+                # float (their comparison is float anyway, and jnp.asarray
+                # cannot take Decimal objects)
+                import decimal
+                d = decimal.Decimal(v).scaleb(-dec_scale(b.dtype))
+                if d == d.to_integral_value():
+                    v = int(d)
+                else:
+                    v = d if is_dec(e.dtype) else float(d)
             values.append(v)
         call = P.BCall("bool", "in_list", [e], extra=values)
         if node.negated:
@@ -1498,22 +1511,32 @@ def _nested_subqueries(node) -> list:
     return out
 
 
+def _trunc_mod(a, b):
+    """Truncated (sign-of-dividend) mod, matching the runtime fmod — Python's
+    % is floored and diverges on negative operands."""
+    r = abs(a) % abs(b)
+    return r if a >= 0 else -r
+
+
 def _const_fold(e: P.BExpr) -> P.BExpr:
     """Fold arithmetic over literals (e.g. the IN-list element [YEAR] + 1
     instantiated as 1999 + 1) into a single literal."""
     if not isinstance(e, P.BCall):
         return e
     ops = {"add": lambda a, b: a + b, "sub": lambda a, b: a - b,
-           "mul": lambda a, b: a * b, "neg": lambda a: -a}
-    if e.op == "div":
-        ops["div"] = lambda a, b: a / b
+           "mul": lambda a, b: a * b, "neg": lambda a: -a,
+           "div": lambda a, b: a / b, "mod": _trunc_mod}
     fn = ops.get(e.op)
     if fn is None:
         return e
     args = [_const_fold(a) for a in e.args]
-    if e.op == "div" and any(is_dec(a.dtype) for a in args):
-        return e    # scaled-int literal division would drop the scales
     if all(isinstance(a, P.BLit) and a.value is not None for a in args):
+        if e.dtype == "float" and any(is_dec(a.dtype) for a in args):
+            # dec literals carry ALREADY-SCALED ints; a float-typed result
+            # (mul/div/mod with a float operand) must fold on descaled values
+            # or it comes out 10^scale too large
+            args = [_fold_cast_literal(a, "float") if is_dec(a.dtype) else a
+                    for a in args]
         try:
             return P.BLit(e.dtype, fn(*[a.value for a in args]))
         except (TypeError, ZeroDivisionError):

@@ -151,10 +151,12 @@ def distributed_aggregate(mesh: Mesh, n_partial: int, specs: list[str],
     specs: per-value aggregation kind, "sum"|"count"|"min"|"max".
     Returned jittable fn: (group_keys [sharded; one array or a list of
     n_keys arrays — composite GROUP BY], valid (same shape), alive, values)
-    -> (group_keys [list, n_partial * n_shards each], agg_values, out_alive,
-    overflow) replicated; overflow counts rows in groups beyond n_partial
-    (callers must size n_partial so it stays 0 — otherwise results are
-    partial). Single-key callers get a single key array back.
+    -> (group_keys, key_valids [False marks a NULL group key — the key
+    array's raw value is meaningless there], agg_values, out_alive,
+    overflow) replicated, n_partial * n_shards rows each; overflow counts
+    rows in groups beyond n_partial (callers must size n_partial so it
+    stays 0 — otherwise results are partial). Single-key callers get single
+    key/valid arrays back.
     """
     axis = mesh.axis_names[0]
 
@@ -167,39 +169,46 @@ def distributed_aggregate(mesh: Mesh, n_partial: int, specs: list[str],
         # dropped by the out-of-range scatter — count them instead
         overflow = jnp.sum((alive & (gid >= n_partial) & (gid < cap))
                            .astype(_I32))
-        reps = []
-        rep_alive = None
+        reps, rep_valids = [], []
         for k, kv in zip(keys, valids):
-            r, ra = kernels.group_representatives(gid, alive, k, kv,
+            r, rv = kernels.group_representatives(gid, alive, k, kv,
                                                   n_partial)
             reps.append(r)
-            rep_alive = ra if rep_alive is None else rep_alive
+            rep_valids.append(rv)
+        # slot occupancy is "some alive row landed here" — NOT any key's
+        # validity (a group whose first GROUP BY key is NULL still exists)
+        occ = jnp.zeros(n_partial + 1, bool).at[
+            jnp.where(alive & (gid < n_partial), gid, n_partial)
+        ].set(True)[:n_partial]
         contrib = alive
-        for kv in valids:
-            contrib = contrib & kv
         partials = [_partial_agg(spec, v, contrib, gid, n_partial)
                     for spec, v in zip(specs, values)]
         # gather all shards' partials everywhere, merge locally (replicated)
         g_keys = [lax.all_gather(r, axis, tiled=True) for r in reps]
-        g_alive = lax.all_gather(rep_alive, axis, tiled=True)
+        g_valids = [lax.all_gather(rv, axis, tiled=True)
+                    for rv in rep_valids]
+        g_occ = lax.all_gather(occ, axis, tiled=True)
         g_partials = [lax.all_gather(p, axis, tiled=True) for p in partials]
-        m_gid, _ = kernels.dense_rank(g_keys, [g_alive] * len(g_keys),
-                                      g_alive)
+        m_gid, _ = kernels.dense_rank(g_keys, g_valids, g_occ)
         cap_out = g_keys[0].shape[0]
-        out_keys, out_alive = [], None
-        for gk in g_keys:
-            ok, oa = kernels.group_representatives(m_gid, g_alive, gk,
-                                                   g_alive, cap_out)
+        out_keys, out_valids = [], []
+        for gk, gv in zip(g_keys, g_valids):
+            ok, ov = kernels.group_representatives(m_gid, g_occ, gk, gv,
+                                                   cap_out)
             out_keys.append(ok)
-            out_alive = oa
-        merged = [_merge_agg(spec, p, g_alive, m_gid, cap_out)
+            out_valids.append(ov)
+        out_alive = jnp.zeros(cap_out + 1, bool).at[
+            jnp.where(g_occ, m_gid, cap_out)].set(True)[:cap_out]
+        merged = [_merge_agg(spec, p, g_occ, m_gid, cap_out)
                   for spec, p in zip(specs, g_partials)]
         keys_out = out_keys[0] if single else out_keys
-        return keys_out, merged, out_alive, lax.psum(overflow, axis)
+        valids_out = out_valids[0] if single else out_valids
+        return keys_out, valids_out, merged, out_alive, \
+            lax.psum(overflow, axis)
 
     return shard_map(local, mesh=mesh,
                      in_specs=(P(axis), P(axis), P(axis), P(axis)),
-                     out_specs=(P(), P(), P(), P()), check_vma=False)
+                     out_specs=(P(), P(), P(), P(), P()), check_vma=False)
 
 
 def broadcast_join_aggregate(mesh: Mesh, n_partial: int, specs: list[str]):

@@ -64,16 +64,51 @@ def _match_paren(sql: str, open_pos: int) -> int:
     raise ValueError("unbalanced parens")
 
 
+_AGG_CALL = re.compile(r"\b(sum|min|max|avg|count|stddev_samp)\s*\(",
+                       re.IGNORECASE)
+
+
+def _null_outside_aggs(text: str, rolled: set[str]) -> str:
+    """Substitute NULL for rolled-up columns in every context EXCEPT inside
+    aggregate-call arguments (which see underlying row values, per
+    grouping-sets semantics) and string literals."""
+    strings = [m.span() for m in re.finditer(r"'[^']*'", text)]
+    protected: list[tuple[int, int]] = list(strings)
+    for m in _AGG_CALL.finditer(text):
+        if any(s <= m.start() < e for s, e in strings):
+            continue    # agg-looking text inside a string literal
+        open_pos = text.index("(", m.end() - 1)
+        protected.append((m.start(), _match_paren(text, open_pos) + 1))
+
+    def shielded(i: int, j: int) -> bool:
+        return any(s <= i and j <= e for s, e in protected)
+
+    pattern = re.compile(
+        "|".join(rf"\b{re.escape(c)}\b" for c in sorted(rolled)),
+        re.IGNORECASE)
+    out, last = [], 0
+    for m in pattern.finditer(text):
+        if shielded(m.start(), m.end()):
+            continue
+        out.append(text[last:m.start()])
+        out.append("NULL")
+        last = m.end()
+    out.append(text[last:])
+    return "".join(out)
+
+
 def _rollup_variant(select_list: str, cols: list[str], p: int) -> str:
     """Rewrite a select list for the rollup prefix of length p: GROUPING(c)
-    folds to 0 (grouped) / 1 (rolled up); rolled-up columns project NULL."""
+    folds to 0 (grouped) / 1 (rolled up); rolled-up columns become NULL
+    outside aggregate args and string literals (inside them, grouping-sets
+    semantics keep the underlying value)."""
     for i, c in enumerate(cols):
         select_list = re.sub(
             rf"GROUPING\s*\(\s*{re.escape(c)}\s*\)",
             "0" if i < p else "1", select_list, flags=re.IGNORECASE)
-    for c in cols[p:]:
-        select_list = re.sub(rf"\b{re.escape(c)}\b", "NULL", select_list,
-                             flags=re.IGNORECASE)
+    rolled = {c.strip() for c in cols[p:]}
+    if rolled:
+        select_list = _null_outside_aggs(select_list, rolled)
     return select_list
 
 

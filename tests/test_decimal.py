@@ -236,3 +236,41 @@ def test_use_decimal_end_to_end(tmp_path):
             assert validate.row_equal(re_, ra_, f"query{number}",
                                       list(expected.names)), \
                 f"query{number}: {re_} != {ra_}"
+
+
+def test_const_fold_dec_literal_with_float(dec_session):
+    """Round-2 advisor (planner.py _const_fold): a dec literal in a
+    float-typed fold must descale first — CAST(1.00 AS DECIMAL(7,2)) * 0.5
+    is 0.5, not the raw scaled int 100 * 0.5 = 50."""
+    rows_ = rows(dec_session.sql(
+        "SELECT k FROM t WHERE f IN (CAST(1.00 AS DECIMAL(7,2)) * 0.5)"))
+    assert rows_ == [(1,)]
+    # division folds too (previously left unfolded -> PlanError in IN lists)
+    rows_ = rows(dec_session.sql(
+        "SELECT k FROM t WHERE f IN (CAST(1.00 AS DECIMAL(7,2)) / 2)"))
+    assert rows_ == [(1,)]
+    # dec * int stays exact on scaled ints
+    rows_ = rows(dec_session.sql(
+        "SELECT k FROM t WHERE p IN (CAST(1.10 AS DECIMAL(7,2)) * 2, "
+        "CAST(7.00 AS DECIMAL(7,2)))"))
+    assert sorted(rows_) == [(3,)]
+    # folded mod uses truncated (fmod) semantics: (0-7) % 2 = -1, not +1
+    rows_ = rows(dec_session.sql(
+        "SELECT k FROM t WHERE q IN (9, (0 - 7) % 2 + 3)"))
+    assert sorted(rows_) == [(1,), (2,)]
+
+
+def test_wide_decimal_column_no_silent_wrap():
+    """Round-2 advisor (arrow_bridge._decimal_to_scaled_i64): precision>18
+    columns take the exact loop; in-range values convert exactly and
+    out-of-int64 values raise instead of wrapping silently."""
+    from nds_tpu.engine.arrow_bridge import from_arrow_column
+    ok = pa.array([D("123.45"), None, D("-9999999999999999.99")],
+                  type=pa.decimal128(20, 2))
+    col = from_arrow_column(ok, dec_as_int=True)
+    assert col.dtype == "dec2"
+    assert col.data[0] == 12345
+    assert col.data[2] == -999999999999999999
+    bad = pa.array([D("9300000000000000000")], type=pa.decimal128(20, 0))
+    with pytest.raises(OverflowError):
+        from_arrow_column(bad, dec_as_int=True)

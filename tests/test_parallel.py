@@ -67,7 +67,7 @@ def test_distributed_aggregate_matches_host(mesh):
         [jnp.asarray(key), jnp.asarray(val)], jnp.asarray(alive_h), mesh)
     fn = jax.jit(distributed_aggregate(mesh, n_partial=64,
                                        specs=["sum", "count"]))
-    out_keys, (sums, counts), out_alive, overflow = fn(
+    out_keys, out_valid, (sums, counts), out_alive, overflow = fn(
         skey, jnp.ones_like(salive), salive, [sval, sval])
     assert int(overflow) == 0
     mask = np.asarray(out_alive)
@@ -133,7 +133,7 @@ def test_distributed_aggregate_multi_key_minmax(mesh):
     ones = jnp.ones_like(salive)
     fn = jax.jit(distributed_aggregate(mesh, n_partial=64,
                                        specs=["min", "max", "sum"]))
-    out_keys, (mins, maxs, sums), out_alive, overflow = fn(
+    out_keys, out_valids, (mins, maxs, sums), out_alive, overflow = fn(
         [sk1, sk2], [ones, ones], salive, [sval, sval, sval])
     assert int(overflow) == 0
     mask = np.asarray(out_alive)
@@ -148,6 +148,33 @@ def test_distributed_aggregate_multi_key_minmax(mesh):
         m, x, s = want.get((int(a), int(b)), (10**9, -10**9, 0))
         want[(int(a), int(b))] = (min(m, int(v)), max(x, int(v)), s + int(v))
     assert got == want
+
+
+def test_distributed_aggregate_null_first_key(mesh):
+    """Round-2 advisor (dist_ops): a group whose FIRST GROUP BY key is NULL
+    must survive — slot occupancy comes from alive rows, not from the first
+    key's validity."""
+    n = 64
+    k1 = np.arange(n, dtype=np.int32) % 3
+    k1_valid = (np.arange(n) % 3) != 0           # k1 NULL for group 0
+    k2 = np.full(n, 9, np.int32)
+    val = np.ones(n, np.float32)
+    (sk1, sk2, sv1, sval), salive = shard_rows(
+        [jnp.asarray(k1), jnp.asarray(k2), jnp.asarray(k1_valid),
+         jnp.asarray(val)], jnp.ones(n, bool), mesh)
+    fn = jax.jit(distributed_aggregate(mesh, n_partial=32, specs=["sum"]))
+    out_keys, out_valids, (sums,), out_alive, overflow = fn(
+        [sk1, sk2], [sv1, jnp.ones_like(salive)], salive, [sval])
+    assert int(overflow) == 0
+    mask = np.asarray(out_alive)
+    # three groups: (NULL,9), (1,9), (2,9) — the NULL-first-key group has
+    # ceil(64/3) rows and must not be dropped
+    assert int(mask.sum()) == 3
+    v1 = np.asarray(out_valids[0])[mask]
+    k1o = np.asarray(out_keys[0])[mask]
+    got = {None if not v else int(k): float(s)
+           for v, k, s in zip(v1, k1o, np.asarray(sums)[mask])}
+    assert got == {None: 22.0, 1: 21.0, 2: 21.0}
 
 
 def test_repartition_composite_key(mesh):

@@ -120,6 +120,9 @@ def _skip(section: dict) -> bool:
 
 def run_full_bench(cfg: dict) -> dict:
     """Run every phase per the YAML config; returns the collected times."""
+    from .config import maybe_enable_compile_cache
+
+    maybe_enable_compile_cache()
     sf = float(cfg["data_gen"]["scale_factor"])
     num_streams = int(cfg["generate_query_stream"]["num_streams"])
     sq = num_streams // 2

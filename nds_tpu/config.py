@@ -41,6 +41,14 @@ class EngineConfig:
     # NDS_TPU_JIT_PLANS=0 disables globally (e.g. compile-bound CI runs)
     jit_plans: bool = field(default_factory=lambda: _env_bool(
         "NDS_TPU_JIT_PLANS", True))
+    # CTE-boundary compile segmentation: plans with at least this many nodes
+    # split each sufficiently large CTE subtree into its own XLA program
+    # whose output stays device-resident (bounds q4-class compile times and
+    # shares materialized CTEs across q14/q23 parts). 0 disables.
+    segment_plan_nodes: int = 40
+    segment_min_cte_nodes: int = 8
+    # device-resident segment outputs kept before LRU eviction
+    segment_cache_entries: int = 16
 
     @staticmethod
     def from_property_file(path: str | None) -> "EngineConfig":
@@ -85,6 +93,25 @@ def enable_x64() -> None:
     import jax
 
     jax.config.update("jax_enable_x64", True)
+
+
+def maybe_enable_compile_cache() -> None:
+    """Default-on persistent compile cache for every runner (power,
+    throughput, maintenance, orchestrator) — the reference reuses Spark's
+    compiled plans across the whole stream (nds/nds_power.py:124-134);
+    recompiling per process would bill XLA compile time to every phase.
+    Opt out with NDS_TPU_COMPILE_CACHE=0 (or =off)."""
+    raw = os.environ.get("NDS_TPU_COMPILE_CACHE", "1")
+    v = raw.lower()
+    if v in ("0", "false", "no", "off"):
+        return
+    if v in ("1", "true", "yes", "on"):
+        # explicit default path: enable_compile_cache(None) would re-read
+        # the env var and mint a directory literally named after the token
+        path = os.path.join(os.path.expanduser("~"), ".cache", "nds_tpu_xla")
+    else:
+        path = raw           # case-preserved custom directory
+    enable_compile_cache(path)
 
 
 def enable_compile_cache(path: str | None = None) -> None:

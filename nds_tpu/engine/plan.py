@@ -170,6 +170,18 @@ class MaterializedNode(PlanNode):
     label: str = ""
 
 
+@dataclass
+class VirtualScanNode(PlanNode):
+    """A scan whose table is the output of another compile unit (a segmented
+    CTE): the device executor resolves `key` against its segment cache, so a
+    pathologically large plan splits into bounded XLA programs that hand
+    device-resident tables to each other (reference analog: Spark reuses one
+    compiled plan per query and materializes nothing, nds/nds_power.py:124-134
+    — here bounded compile time requires the cut)."""
+    key: str
+    label: str = ""
+
+
 def walk(node: PlanNode):
     """Pre-order traversal of a plan tree."""
     yield node
@@ -177,3 +189,63 @@ def walk(node: PlanNode):
         sub = getattr(node, f, None)
         if isinstance(sub, PlanNode):
             yield from walk(sub)
+
+
+def iter_plan_nodes(root: PlanNode):
+    """Every distinct PlanNode reachable from `root`, INCLUDING plans embedded
+    in expressions (BScalarSubquery) — shared nodes (CTE DAG) yield once."""
+    import dataclasses as _dc
+
+    seen: set[int] = set()
+    stack: list = [root]
+    while stack:
+        x = stack.pop()
+        if isinstance(x, PlanNode):
+            if id(x) in seen:
+                continue
+            seen.add(id(x))
+            yield x
+            if isinstance(x, MaterializedNode):
+                continue      # its Table payload holds no plan nodes
+        if _dc.is_dataclass(x) and not isinstance(x, type):
+            for f in _dc.fields(x):
+                stack.append(getattr(x, f.name))
+        elif isinstance(x, (list, tuple)):
+            stack.extend(x)
+
+
+def replace_plan_nodes(root, mapping: dict):
+    """Functionally rewrite a plan DAG, substituting nodes by identity:
+    mapping[id(node)] -> replacement. Untouched shared subtrees keep their
+    identity (executor memoization still dedupes them); expression-embedded
+    plans (BScalarSubquery) are rewritten too."""
+    import dataclasses as _dc
+
+    memo: dict[int, object] = {}
+
+    def rw(x):
+        if isinstance(x, PlanNode) and id(x) in mapping:
+            return mapping[id(x)]
+        if isinstance(x, MaterializedNode):
+            return x          # leaf: its Table payload holds no plan nodes
+        if _dc.is_dataclass(x) and not isinstance(x, type):
+            if id(x) in memo:
+                return memo[id(x)]
+            changes = {}
+            for f in _dc.fields(x):
+                v = getattr(x, f.name)
+                nv = rw(v)
+                if nv is not v:
+                    changes[f.name] = nv
+            out = _dc.replace(x, **changes) if changes else x
+            memo[id(x)] = out
+            return out
+        if isinstance(x, list):
+            nl = [rw(e) for e in x]
+            return nl if any(a is not b for a, b in zip(nl, x)) else x
+        if isinstance(x, tuple):
+            nt = tuple(rw(e) for e in x)
+            return nt if any(a is not b for a, b in zip(nt, x)) else x
+        return x
+
+    return rw(root)

@@ -102,14 +102,36 @@ class _Unit:
 class Planner:
     def __init__(self, catalog: Catalog):
         self.catalog = catalog
+        # CTE compile-segmentation candidates: (fingerprint, plan node) in
+        # definition order (definition-before-use => topological). The
+        # fingerprint is STABLE across planner instances (AST-derived), so
+        # q14/q23-style multi-part statements sharing a WITH clause map to
+        # the same segment cache slots.
+        self.cte_segments: list[tuple[str, P.PlanNode]] = []
+        self._cte_fp: dict[int, str] = {}
 
     # -- public ------------------------------------------------------------
     def plan_query(self, q: A.Query, outer: Optional[Scope] = None,
                    ctes: Optional[dict] = None) -> P.PlanNode:
+        top = ctes is None
         ctes = dict(ctes or {})
         for name, cq in q.ctes:
-            ctes[name] = self.plan_query(cq, outer=None, ctes=ctes)
+            ctes[name] = self._plan_cte(name, cq, ctes)
         node = self._plan_body(q.body, outer, ctes, q.order_by, q.limit)
+        if top:
+            node.cte_segments = list(self.cte_segments)
+        return node
+
+    def _plan_cte(self, name: str, cq: A.Query, ctes: dict) -> P.PlanNode:
+        """Plan one WITH entry and register it as a segmentation candidate."""
+        import hashlib
+
+        node = self.plan_query(cq, outer=None, ctes=ctes)
+        visible = ";".join(f"{n}:{self._cte_fp.get(id(p), '')}"
+                           for n, p in sorted(ctes.items()))
+        fp = hashlib.sha1(f"{name}|{cq!r}|{visible}".encode()).hexdigest()[:16]
+        self._cte_fp[id(node)] = fp
+        self.cte_segments.append((fp, node))
         return node
 
     # -- query body ---------------------------------------------------------
@@ -737,7 +759,7 @@ class Planner:
         if subq.ctes:
             ctes = dict(ctes)
             for nm, cq in subq.ctes:
-                ctes[nm] = self.plan_query(cq, outer=None, ctes=ctes)
+                ctes[nm] = self._plan_cte(nm, cq, ctes)
         body = subq.body
         if not isinstance(body, A.Select):
             raise PlanError("unsupported subquery form")
@@ -776,7 +798,7 @@ class Planner:
         if subq.ctes:
             ctes = dict(ctes)
             for nm, cq in subq.ctes:
-                ctes[nm] = self.plan_query(cq, outer=None, ctes=ctes)
+                ctes[nm] = self._plan_cte(nm, cq, ctes)
         body = subq.body
         if not isinstance(body, A.Select) or len(body.items) != 1:
             raise PlanError("unsupported correlated scalar subquery")

@@ -71,9 +71,13 @@ class Session:
         keeps tables hot on the executors across the 103-query power run)."""
         if self._jax_exec is None or self._jax_exec_gen != self._generation:
             from .jax_backend import JaxExecutor
-            self._jax_exec = JaxExecutor(self.load_table,
-                                         jit_plans=self.config.jit_plans,
-                                         mesh=self._device_mesh())
+            cfg = self.config
+            self._jax_exec = JaxExecutor(
+                self.load_table, jit_plans=cfg.jit_plans,
+                mesh=self._device_mesh(),
+                segment_plan_nodes=cfg.segment_plan_nodes,
+                segment_min_cte_nodes=cfg.segment_min_cte_nodes,
+                segment_cache_entries=cfg.segment_cache_entries)
             self._jax_exec_gen = self._generation
         return self._jax_exec
 

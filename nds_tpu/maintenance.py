@@ -82,6 +82,9 @@ def run_maintenance(warehouse_path: str, refresh_dir: str, time_log: str,
                     json_summary_folder: str | None = None,
                     backend: str | None = None
                     ) -> list[tuple[str, int, int, int]]:
+    from .config import maybe_enable_compile_cache
+
+    maybe_enable_compile_cache()
     config = EngineConfig()
     session = Session(config)
     wh = Warehouse(warehouse_path)

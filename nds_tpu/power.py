@@ -168,7 +168,9 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
     going, exactly like a genuine mid-stream query failure.
     """
     from .check import check_json_summary_folder, check_query_subset_exists
+    from .config import maybe_enable_compile_cache
 
+    maybe_enable_compile_cache()
     check_json_summary_folder(json_summary_folder)
     config = EngineConfig.from_property_file(property_file)
     session = Session(config)

@@ -34,9 +34,12 @@ def _stream_cmd(input_prefix: str, stream_file: str, time_log: str,
                 input_format: str, output_prefix: str | None,
                 json_summary_folder: str | None,
                 sub_queries: list[str] | None,
-                property_file: str | None, backend: str | None) -> list[str]:
+                property_file: str | None, backend: str | None,
+                warmup: int = 0) -> list[str]:
     cmd = [sys.executable, "-m", "nds_tpu.power", input_prefix, stream_file,
            time_log, "--input_format", input_format]
+    if warmup:
+        cmd += ["--warmup", str(warmup)]
     if output_prefix:
         cmd += ["--output_prefix", output_prefix]
     if json_summary_folder:
@@ -58,7 +61,8 @@ def run_throughput(input_prefix: str, stream_dir: str, streams: list[int],
                    sub_queries: list[str] | None = None,
                    property_file: str | None = None,
                    backend: str | None = None,
-                   mode: str = "process") -> float:
+                   mode: str = "process",
+                   warmup: int = 0) -> float:
     """Run the given streams concurrently; returns elapsed seconds.
 
     Elapsed is max(stream Power End) - min(stream Power Start) over the
@@ -77,7 +81,7 @@ def run_throughput(input_prefix: str, stream_dir: str, streams: list[int],
         procs = [subprocess.Popen(
             _stream_cmd(input_prefix, sf, log, input_format, out,
                         json_summary_folder, sub_queries, property_file,
-                        backend))
+                        backend, warmup))
             for sf, log, out in jobs]
         failed = [p.args for p in procs if p.wait() != 0]
         if failed:
@@ -89,7 +93,7 @@ def run_throughput(input_prefix: str, stream_dir: str, streams: list[int],
                 input_format=input_format, output_prefix=out,
                 json_summary_folder=json_summary_folder,
                 sub_queries=sub_queries, property_file=property_file,
-                backend=backend)
+                backend=backend, warmup=warmup)
                 for sf, log, out in jobs]
             for f in futures:
                 f.result()
@@ -132,13 +136,15 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--backend", default=None, choices=["jax", "numpy"])
     p.add_argument("--mode", default="process",
                    choices=["process", "thread"])
+    p.add_argument("--warmup", type=int, default=0,
+                   help="untimed pre-runs per query in each stream")
     a = p.parse_args(argv)
     ids = [int(s) for s in a.streams.split(",")]
     sub = a.sub_queries.split(",") if a.sub_queries else None
     elapsed = run_throughput(a.input_prefix, a.stream_dir, ids,
                              a.time_log_dir, a.input_format, a.output_prefix,
                              a.json_summary_folder, sub, a.property_file,
-                             a.backend, a.mode)
+                             a.backend, a.mode, a.warmup)
     print(f"Throughput Test Time: {elapsed:.3f} seconds")
     return 0
 

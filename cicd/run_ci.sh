@@ -1,0 +1,51 @@
+#!/usr/bin/env bash
+# Executable CI pipeline for NDS-TPU (invoked stage-by-stage by
+# cicd/ci.yml, runnable locally: `bash cicd/run_ci.sh all`).
+#
+# Stages:
+#   native  - build the C++ data generator and self-check one tiny table
+#   test    - full pytest suite on an 8-virtual-device CPU mesh
+#   bench   - quick bench slice (SF 0.01) to catch perf regressions early
+#   all     - every stage in order
+set -euo pipefail
+
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}"
+export NDS_TPU_JIT_PLANS=1
+
+stage_native() {
+    make -C "$REPO/native/datagen"
+    local out
+    out="$(mktemp -d)"
+    "$REPO/native/bin/ndsdgen" -scale 0.01 -dir "$out" -table date_dim \
+        -parallel 1 -child 1
+    # self-check: date_dim is fixed-size (73049 rows) at every SF
+    local rows
+    rows="$(wc -l < "$out/date_dim.dat")"
+    rm -rf "$out"
+    [ "$rows" -eq 73049 ] || {
+        echo "native self-check failed: date_dim rows=$rows" >&2; exit 1; }
+    echo "native OK"
+}
+
+stage_test() {
+    (cd "$REPO" && python -m pytest tests/ -q --durations=15)
+}
+
+stage_bench() {
+    local d
+    d="$(mktemp -d)"
+    (cd "$REPO" && NDS_TPU_BENCH_DIR="$d" NDS_TPU_BENCH_SF=0.01 \
+        NDS_TPU_BENCH_QUERIES=query3,query7 python bench.py)
+    rm -rf "$d"
+}
+
+case "${1:-all}" in
+    native) stage_native ;;
+    test)   stage_test ;;
+    bench)  stage_bench ;;
+    all)    stage_native; stage_test; stage_bench ;;
+    --list) echo "native test bench all" ;;
+    *) echo "usage: run_ci.sh [native|test|bench|all|--list]" >&2; exit 2 ;;
+esac

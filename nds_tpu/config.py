@@ -33,8 +33,13 @@ class EngineConfig:
     chunk_rows: int = 1 << 20
     # out-of-core execution: stream aggregates over one large scan in
     # chunk_rows morsels (bounded peak memory; SURVEY.md §5 long-context
-    # analog). Eligible plans only; others run in-core.
-    out_of_core: bool = False
+    # analog). Eligible plans only; others run in-core. Default ON with a
+    # big-table threshold well above SF10 fact sizes, so small scales keep
+    # the scan-resident fast path and SF100-class scans stream.
+    out_of_core: bool = True
+    # a scan streams (rather than pinning device-resident) when its table
+    # exceeds this row count
+    out_of_core_min_rows: int = 48_000_000
     # run jitted per-op kernels (True) or pure-numpy fallback (False, debug only)
     use_jax: bool = True
     # compile whole plans to one XLA program on re-execution (record/replay);
@@ -49,6 +54,17 @@ class EngineConfig:
     segment_min_cte_nodes: int = 8
     # device-resident segment outputs kept before LRU eviction
     segment_cache_entries: int = 16
+    # row-shard a scan over the mesh only above this row count; smaller
+    # tables replicate (the broadcast-join layout: building a replicated
+    # join LUT from a SHARDED build side costs dim-sized collectives, so
+    # dimension tables — date_dim 73k, item 204k at SF100 — stay whole)
+    shard_min_rows: int = 1 << 18
+    # HBM budget (GB) for device-resident scans + segment outputs; the
+    # least-recently-used unpinned entries evict when the cap is exceeded
+    # (reference analog: Spark executors bound storage memory and re-read
+    # from the warehouse; here eviction forces a re-upload on next use).
+    # 0 disables eviction.
+    scan_budget_gb: float = 10.0
 
     @staticmethod
     def from_property_file(path: str | None) -> "EngineConfig":

@@ -82,15 +82,21 @@ def _verify_schedule(decisions: list, checks_host: list) -> None:
 class CompiledQuery:
     """One whole-plan XLA program built from a recorded capacity schedule."""
 
-    def __init__(self, plan: PlanNode, decisions: list, scan_keys: tuple):
+    def __init__(self, plan: PlanNode, decisions: list, scan_keys: tuple,
+                 mesh=None):
         self.plan = plan
         self.decisions = decisions
         self.scan_keys = scan_keys
+        self.mesh = mesh
         self._fn = None
 
     def _trace(self, scans: dict):
         rec = _Recorder("replay", self.decisions)
-        ex = JaxExecutor(_no_load, recorder=rec, scan_tables=scans)
+        # the mesh MUST match the recording executor's: static branches
+        # (compaction skip, shard-local aggregation) key on it, and a
+        # mesh-less replay would consume a mesh-recorded schedule
+        ex = JaxExecutor(_no_load, recorder=rec, scan_tables=scans,
+                         mesh=self.mesh)
         out = ex.execute(self.plan)
         if rec.idx != len(rec.decisions):
             raise NotJittable("decision schedule length drift")
@@ -141,10 +147,11 @@ class JaxExecutor:
                  scan_tables: Optional[dict] = None,
                  jit_plans: bool = True,
                  mesh=None,
-                 shard_min_rows: int = 1 << 14,
+                 shard_min_rows: int = 1 << 18,
                  segment_plan_nodes: int = 40,
                  segment_min_cte_nodes: int = 8,
-                 segment_cache_entries: int = 16):
+                 segment_cache_entries: int = 16,
+                 scan_budget_bytes: int = 10 << 30):
         self._load_table = load_table
         self._memo: dict[int, DTable] = {}
         self._scan_cache: dict[str, DTable] = scan_tables if scan_tables \
@@ -171,6 +178,11 @@ class JaxExecutor:
         self._seg_cache_entries = segment_cache_entries
         self._segment_lru: list[str] = []
         self._pinned_segments: set[str] = set()
+        # HBM accounting for the accelerator-resident cache: key -> bytes,
+        # in LRU order (python dicts preserve insertion; re-touch moves to
+        # the end). Evicting frees the arrays for XLA to reuse.
+        self._scan_budget = scan_budget_bytes
+        self._resident: dict[str, int] = {}
         # Eager (record / fallback) execution runs on the host CPU backend
         # when the default device is an accelerator: per-op dispatch latency
         # through a device tunnel is catastrophic, and the record pass only
@@ -297,6 +309,7 @@ class JaxExecutor:
         """Stash a segment output for downstream units; LRU-bounded."""
         if self.last_stats.get("mode") in ("compiled", "compile+run"):
             self._scan_cache[seg_key] = out
+            self._account_resident(seg_key, out)
         else:          # record/eager output lives on the record-side device
             self._scan_cache_rec[seg_key] = out
         self._touch_segment(seg_key)
@@ -311,6 +324,7 @@ class JaxExecutor:
             old = evictable.pop(0)
             self._segment_lru.remove(old)
             self._scan_cache.pop(old, None)
+            self._resident.pop(old, None)
             if self._scan_cache_rec is not self._scan_cache:
                 self._scan_cache_rec.pop(old, None)
 
@@ -346,7 +360,7 @@ class JaxExecutor:
                 return self._eager(ent["plan"])
             else:                                      # second sighting
                 cq = CompiledQuery(ent["plan"], ent["decisions"],
-                                   ent["scan_keys"])
+                                   ent["scan_keys"], mesh=self._mesh)
                 try:
                     out = self._run_compiled(cq, ent, keep_device)
                     ent["cq"] = cq
@@ -380,6 +394,20 @@ class JaxExecutor:
                 "scan_keys": scan_keys,
                 "cq": None, "nojit": len(self.fallback_nodes) > fb0}
         return out
+
+    def compiled_hlo(self, key) -> Optional[str]:
+        """Optimized (post-GSPMD) HLO of the steady-state program for `key`
+        (the root unit when segmented) — collective-volume inspection for
+        the mesh test-suite (SURVEY.md §2 parallelism table: shuffle must
+        repartition, not rebuild, sharded fact tables)."""
+        for k in ((key, "root"), key):
+            ent = self._plans.get(k)
+            if ent is not None and ent.get("cq") is not None \
+                    and ent["cq"]._fn is not None:
+                cq = ent["cq"]
+                lowered = cq._fn.lower(self._scans_for(ent))
+                return lowered.compile().as_text()
+        return None
 
     def record_plan(self, plan: PlanNode):
         """Eager run that records the capacity schedule; returns
@@ -415,6 +443,39 @@ class JaxExecutor:
                 return self.execute(plan)
         return self.execute(plan)
 
+    @staticmethod
+    def _dtable_bytes(t: DTable) -> int:
+        total = int(t.alive.size)
+        for c in t.cols:
+            for leaf in jax.tree_util.tree_leaves(c):
+                total += int(leaf.size) * leaf.dtype.itemsize
+        return total
+
+    def _account_resident(self, key: str, t: DTable,
+                          pinned: Optional[set] = None) -> None:
+        """Track an accelerator-resident entry; evict LRU past the budget.
+
+        _resident strictly mirrors _scan_cache (stale keys pruned here), so
+        budget math never counts phantom entries."""
+        for k in [k for k in self._resident if k not in self._scan_cache]:
+            del self._resident[k]
+        self._resident.pop(key, None)
+        self._resident[key] = self._dtable_bytes(t)
+        if self._scan_budget <= 0:
+            return
+        pinned = pinned or set()
+        pinned = pinned | getattr(self, "_pinned_segments", set())
+        total = sum(self._resident.values())
+        for old in list(self._resident):
+            if total <= self._scan_budget:
+                break
+            if old == key or old in pinned:
+                continue
+            total -= self._resident.pop(old)
+            self._scan_cache.pop(old, None)
+            if old in self._segment_lru:
+                self._segment_lru.remove(old)
+
     def _scans_for(self, ent) -> dict:
         """Accelerator-resident scan tables for a compiled run (uploaded
         lazily on first use, then shared by every compiled query)."""
@@ -445,6 +506,9 @@ class JaxExecutor:
                 self._scan_cache[k] = to_device(
                     host, device=self._exec_sharding(_bucket(host.num_rows)))
             out[k] = self._scan_cache[k]
+        pinned = set(ent["scan_keys"])
+        for k in ent["scan_keys"]:
+            self._account_resident(k, out[k], pinned)
         return out
 
     def execute(self, node: PlanNode) -> DTable:
@@ -583,6 +647,13 @@ class JaxExecutor:
         count_t = t.count()
         count = self._decide_cap(count_t)
         cap = bucket(count)
+        if self._mesh is not None:
+            # compaction is a global permutation (sort/cumsum/gather): under
+            # SPMD it would force GSPMD to all-gather the sharded buffer.
+            # Alive-masked ops stay shard-local, so larger masked capacities
+            # beat rebuilding the table across the ICI. (The cap decision
+            # above still records, keeping schedules mode-agnostic.)
+            return t
         if t.capacity <= 2 * cap:
             return t
         perm, _ = kernels.compaction_perm(t.alive)
@@ -780,11 +851,232 @@ class JaxExecutor:
         if node.rollup:
             grouping_sets = [list(range(k))
                              for k in range(len(node.group_exprs), -1, -1)]
-        pieces = [self._aggregate_one(node, child, keep)
+        pieces = [self._aggregate_one_sharded(node, child, keep)
+                  if self._mesh_agg_eligible(node, keep)
+                  else self._aggregate_one(node, child, keep)
                   for keep in grouping_sets]
         if len(pieces) == 1:
             return pieces[0]
         return _concat_dtables(pieces, list(node.out_names))
+
+    def _mesh_agg_eligible(self, node: AggregateNode, keep: list[int]) -> bool:
+        """Shard-local grouped aggregation (partial agg + bounded-partials
+        all_gather + replicated merge — the Spark partial/final aggregate
+        plan, SURVEY.md §2 parallelism table). Static eligibility so record
+        and replay take the same branch."""
+        if self._mesh is None or not keep:
+            return False
+        for s in node.aggs:
+            if s.distinct or s.func not in ("sum", "count", "count_star",
+                                            "min", "max", "avg"):
+                return False
+        return True
+
+    def _aggregate_one_sharded(self, node: AggregateNode, child: DTable,
+                               keep: list[int]) -> DTable:
+        """GROUP BY over row-sharded data WITHOUT gathering the fact table:
+        each shard dense-ranks its local rows and aggregates into n_partial
+        slots; only the bounded partials ride the ICI (all_gather), and the
+        replicated merge re-ranks 8*n_partial candidate groups. GSPMD's
+        fallback for the same plan all-gathers the whole child (measured:
+        q3-class group-by gathered cap-sized s32 buffers)."""
+        from jax import shard_map
+        from jax.sharding import PartitionSpec
+        from .device import string_rank_maps
+
+        mesh = self._mesh
+        axis = mesh.axis_names[0]
+        Pax, Prep = PartitionSpec(axis), PartitionSpec()
+        group_cols = [self._eval(e, child) for e in node.group_exprs]
+        active = [group_cols[i] for i in keep]
+        rank_keys = tuple(rank_key(c) for c in active)
+        kvalids = tuple(c.valid for c in active)
+        codes = tuple(c.canon().data for c in active)
+        alive = child.alive
+
+        # per-spec local inputs + merge recipes (streaming.py-style
+        # decomposition into mergeable pieces)
+        spec_args: list = []
+        recipes: list[tuple] = []     # (kind, extra) per spec
+        for spec in node.aggs:
+            if spec.arg is None:
+                spec_args.append(None)
+                recipes.append(("count_star", None))
+                continue
+            ac = self._eval(spec.arg, child)
+            post = None
+            data, valid = ac.canon().data, ac.valid
+            if ac.dtype == "str":
+                if spec.func == "count":
+                    recipes.append(("count", None))
+                elif spec.func in ("min", "max"):
+                    ranks, rank_to_code = string_rank_maps(ac.dictionary)
+                    data = jexprs._lut_gather(ac.data, ranks)
+                    post = ("str", rank_to_code, ac.dictionary)
+                    recipes.append((spec.func, post))
+                else:
+                    raise NotImplementedError(
+                        f"device {spec.func} over strings")
+            elif spec.func == "avg":
+                if is_dec(ac.dtype):
+                    post = ("dec_avg", dec_scale(ac.dtype))
+                recipes.append(("avg", post))
+            else:
+                if spec.func == "sum" and (ac.dtype == "int"
+                                           or is_dec(ac.dtype)):
+                    data = data.astype(phys_dtype("int"))
+                recipes.append((spec.func, None))
+            spec_args.append((data, valid))
+        spec_args = tuple(spec_args)
+
+        nsh = mesh.devices.size
+
+        def probe(rk, kv, al):
+            _, ng = kernels.dense_rank(list(rk), list(kv), al)
+            return ng.reshape(1)
+
+        ng_sh = shard_map(probe, mesh=mesh, in_specs=(Pax, Pax, Pax),
+                          out_specs=Pax, check_vma=False)(
+            rank_keys, kvalids, alive)
+        n_partial = bucket(max(self._decide_cap(jnp.max(ng_sh)), 1))
+        cap_out = n_partial * nsh
+
+        def seg_sum(vals, mask, m_gid, occ):
+            sg = jnp.where(occ & mask, m_gid, cap_out)
+            return jax.ops.segment_sum(jnp.where(occ & mask, vals, 0), sg,
+                                       num_segments=cap_out + 1)[:cap_out]
+
+        def seg_any(mask, m_gid, occ):
+            sg = jnp.where(occ, m_gid, cap_out)
+            return jax.ops.segment_max(
+                (occ & mask).astype(_I32), sg,
+                num_segments=cap_out + 1)[:cap_out] > 0
+
+        def local(rk, kv, cd, al, sa):
+            gid, _ = kernels.dense_rank(list(rk), list(kv), al)
+            occ = jnp.zeros(n_partial + 1, bool).at[
+                jnp.where(al & (gid < n_partial), gid, n_partial)
+            ].set(True)[:n_partial]
+            rreps, creps, cvals = [], [], []
+            for r, v, c in zip(rk, kv, cd):
+                rr, _ = kernels.group_representatives(gid, al, r, v,
+                                                      n_partial)
+                cc, vv = kernels.group_representatives(gid, al, c, v,
+                                                       n_partial)
+                rreps.append(rr)
+                creps.append(cc)
+                cvals.append(vv)
+            parts = []          # flat pieces per recipe, (vals, valid)
+            for (kind, _x), a in zip(recipes, sa):
+                if kind == "count_star":
+                    v, _ = kernels.agg_apply(gid, al, "count_star", None,
+                                             n_partial)
+                    parts.append((v, jnp.ones(n_partial, bool)))
+                elif kind == "count":
+                    v, _ = kernels.agg_apply(gid, al, "count", a, n_partial)
+                    parts.append((v, jnp.ones(n_partial, bool)))
+                elif kind == "avg":
+                    s, sv = kernels.agg_apply(
+                        gid, al, "sum",
+                        (a[0].astype(phys_dtype("int"))
+                         if jnp.issubdtype(a[0].dtype, jnp.integer)
+                         else a[0], a[1]), n_partial)
+                    c, _ = kernels.agg_apply(gid, al, "count", a, n_partial)
+                    parts.append((s, sv))
+                    parts.append((c, jnp.ones(n_partial, bool)))
+                else:           # sum / min / max
+                    v, vv = kernels.agg_apply(gid, al, kind, a, n_partial)
+                    parts.append((v, vv))
+            ga = lambda x: jax.lax.all_gather(x, axis, tiled=True)  # noqa: E731
+            g_occ = ga(occ)
+            g_rr = [ga(x) for x in rreps]
+            g_cc = [ga(x) for x in creps]
+            g_cv = [ga(x) for x in cvals]
+            g_parts = [(ga(v), ga(m)) for v, m in parts]
+            m_gid, _ = kernels.dense_rank(g_rr, g_cv, g_occ)
+            out_codes, out_cvals = [], []
+            for cc, vv in zip(g_cc, g_cv):
+                oc, ov = kernels.group_representatives(m_gid, g_occ, cc, vv,
+                                                       cap_out)
+                out_codes.append(oc)
+                out_cvals.append(ov)
+            out_occ = jnp.zeros(cap_out + 1, bool).at[
+                jnp.where(g_occ, m_gid, cap_out)].set(True)[:cap_out]
+            merged = []
+            pi = 0
+            for kind, _x in recipes:
+                if kind in ("count_star", "count"):
+                    gv, gm = g_parts[pi]
+                    pi += 1
+                    merged.append((seg_sum(gv, gm, m_gid, g_occ),
+                                   jnp.ones(cap_out, bool)))
+                elif kind == "sum":
+                    gv, gm = g_parts[pi]
+                    pi += 1
+                    merged.append((seg_sum(gv, gm, m_gid, g_occ),
+                                   seg_any(gm, m_gid, g_occ)))
+                elif kind in ("min", "max"):
+                    gv, gm = g_parts[pi]
+                    pi += 1
+                    ext = kernels._extreme(gv.dtype, kind)
+                    sg = jnp.where(g_occ & gm, m_gid, cap_out)
+                    seg = jax.ops.segment_min if kind == "min" \
+                        else jax.ops.segment_max
+                    vals = seg(jnp.where(g_occ & gm, gv, ext), sg,
+                               num_segments=cap_out + 1)[:cap_out]
+                    valid = seg_any(gm, m_gid, g_occ)
+                    merged.append((jnp.where(valid, vals,
+                                             jnp.zeros((), gv.dtype)), valid))
+                else:           # avg: sum piece + count piece
+                    gs, gsm = g_parts[pi]
+                    gc, gcm = g_parts[pi + 1]
+                    pi += 2
+                    sm = seg_sum(gs, gsm, m_gid, g_occ)
+                    cm = seg_sum(gc, gcm, m_gid, g_occ)
+                    fdt = jnp.float64 if jax.config.read("jax_enable_x64") \
+                        else jnp.float32
+                    vals = sm.astype(fdt) / jnp.maximum(cm, 1).astype(fdt)
+                    merged.append((vals, cm > 0))
+            return (tuple(out_codes), tuple(out_cvals), out_occ,
+                    tuple(x for pair in merged for x in pair))
+
+        out_codes, out_cvals, out_occ, flat = shard_map(
+            local, mesh=mesh, in_specs=(Pax, Pax, Pax, Pax, Pax),
+            out_specs=(Prep, Prep, Prep, Prep), check_vma=False)(
+            rank_keys, kvalids, codes, alive, spec_args)
+        merged = [(flat[i], flat[i + 1]) for i in range(0, len(flat), 2)]
+
+        out_cols: list[DCol] = []
+        keep_set = set(keep)
+        ai = 0
+        for i, gc in enumerate(group_cols):
+            if i in keep_set:
+                out_cols.append(DCol(gc.dtype, out_codes[ai], out_cvals[ai],
+                                     gc.dictionary))
+                ai += 1
+            else:
+                out_cols.append(DCol(gc.dtype,
+                                     jnp.zeros(cap_out, phys_dtype(gc.dtype)),
+                                     jnp.zeros(cap_out, bool), gc.dictionary))
+        for spec, (kind, post), (vals, valid) in zip(node.aggs, recipes,
+                                                     merged):
+            if isinstance(post, tuple) and post[0] == "str":
+                codes_out = jexprs._lut_gather(vals.astype(_I32), post[1])
+                out_cols.append(DCol("str", codes_out, valid, post[2]))
+                continue
+            if isinstance(post, tuple) and post[0] == "dec_avg":
+                vals = vals / 10.0 ** post[1]
+            out_cols.append(DCol(spec.dtype,
+                                 vals.astype(phys_dtype(spec.dtype)), valid))
+        if node.rollup:
+            gid_val = sum(1 << (len(node.group_exprs) - 1 - i)
+                          for i in range(len(node.group_exprs))
+                          if i not in keep_set)
+            out_cols.append(DCol("int",
+                                 jnp.full(cap_out, gid_val,
+                                          phys_dtype("int")),
+                                 jnp.ones(cap_out, bool)))
+        return DTable(list(node.out_names), out_cols, out_occ)
 
     def _aggregate_one(self, node: AggregateNode, child: DTable,
                        keep: list[int]) -> DTable:

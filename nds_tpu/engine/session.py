@@ -75,9 +75,11 @@ class Session:
             self._jax_exec = JaxExecutor(
                 self.load_table, jit_plans=cfg.jit_plans,
                 mesh=self._device_mesh(),
+                shard_min_rows=cfg.shard_min_rows,
                 segment_plan_nodes=cfg.segment_plan_nodes,
                 segment_min_cte_nodes=cfg.segment_min_cte_nodes,
-                segment_cache_entries=cfg.segment_cache_entries)
+                segment_cache_entries=cfg.segment_cache_entries,
+                scan_budget_bytes=int(cfg.scan_budget_gb * (1 << 30)))
             self._jax_exec_gen = self._generation
         return self._jax_exec
 
@@ -312,7 +314,7 @@ class Session:
             plan = Planner(self._catalog()).plan_query(parse_sql(query))
             sp = streaming.try_streaming_plan(
                 plan, lambda t: self._est_rows.get(t, 0),
-                self.config.chunk_rows)
+                self.config.out_of_core_min_rows)
             if sp is None:
                 self._stream_cache[query] = None
                 return None
@@ -325,8 +327,14 @@ class Session:
                     return t.select(list(columns)) if columns else t
                 return self.load_table(name, columns)
 
-            jexec = JaxExecutor(load, jit_plans=True,
-                                mesh=self._device_mesh())
+            cfg = self.config
+            jexec = JaxExecutor(
+                load, jit_plans=True, mesh=self._device_mesh(),
+                shard_min_rows=cfg.shard_min_rows,
+                segment_plan_nodes=cfg.segment_plan_nodes,
+                segment_min_cte_nodes=cfg.segment_min_cte_nodes,
+                segment_cache_entries=cfg.segment_cache_entries,
+                scan_budget_bytes=int(cfg.scan_budget_gb * (1 << 30)))
             sent = {"sp": sp, "jexec": jexec, "current": current,
                     "cq": None, "ent": None, "mkey": None}
             self._stream_cache[query] = sent
@@ -334,6 +342,7 @@ class Session:
         sp, jexec, current = sent["sp"], sent["jexec"], sent["current"]
         morsels = self.iter_morsels(sp.big_table, sp.big_columns, morsel_rows)
         partials = []
+        re_records = 0
         for morsel in morsels:
             current["table"] = morsel
             if sent["cq"] is None:  # record once, on the first morsel
@@ -344,7 +353,7 @@ class Session:
                     return None  # not device-runnable; use the normal path
                 decisions = streaming.inflate_schedule(decisions, morsel_rows)
                 sent["cq"] = CompiledQuery(sp.partial_plan, decisions,
-                                           scan_keys)
+                                           scan_keys, mesh=jexec._mesh)
                 sent["ent"] = {"scan_keys": scan_keys}
                 sent["mkey"] = next(
                     k for k in scan_keys
@@ -364,6 +373,7 @@ class Session:
                 jexec._scan_cache_rec.pop(mkey, None)
                 jexec._scan_cache.pop(mkey, None)
                 out, _, _ = jexec.record_plan(sp.partial_plan)
+                re_records += 1
             partials.append(arrow_bridge.to_arrow(to_host(out)))
 
         # free the final morsel: the cached executor must not pin a
@@ -385,7 +395,8 @@ class Session:
         result = Executor(self.load_table).execute(final_plan)
         self.last_exec_stats = {"mode": "streaming",
                                 "morsels": len(partials),
-                                "morsel_rows": morsel_rows}
+                                "morsel_rows": morsel_rows,
+                                "re_records": re_records}
         return result
 
     def sql_arrow(self, query: str) -> pa.Table:

@@ -43,13 +43,18 @@ class StreamingPlan:
 
 
 def _path_to_aggregate(plan: PlanNode):
-    """Locate the single AggregateNode with only post-agg nodes above it."""
+    """Locate the single AggregateNode with only post-agg nodes above it.
+
+    Windows ABOVE the aggregate are allowed (rank-over-aggregated shapes):
+    they run in the final phase over the merged partials, which are
+    group-cardinality-sized."""
     path = []
     node = plan
     while True:
         if isinstance(node, AggregateNode):
             return path, node
-        if isinstance(node, (SortNode, LimitNode, ProjectNode, FilterNode)) \
+        if isinstance(node, (SortNode, LimitNode, ProjectNode, FilterNode,
+                             P.WindowNode)) \
                 and not isinstance(node, AggregateNode):
             path.append(node)
             node = node.child
@@ -105,7 +110,7 @@ def _contains_unsupported(sub: PlanNode, big: ScanNode) -> bool:
 def try_streaming_plan(plan: PlanNode, est_rows, threshold: int
                        ) -> Optional[StreamingPlan]:
     path, agg = _path_to_aggregate(plan)
-    if agg is None or agg.rollup:
+    if agg is None:
         return None
     if any(s.distinct for s in agg.aggs):
         return None
@@ -161,14 +166,24 @@ def try_streaming_plan(plan: PlanNode, est_rows, threshold: int
                [s.name for s in partial_specs])
     p_dtypes = ([e.dtype for e in agg.group_exprs] +
                 [s.dtype for s in partial_specs])
+    if agg.rollup:
+        # per-prefix partials: the partial aggregate emits every rollup
+        # grouping set per morsel (rolled-up cols NULL + __grouping_id),
+        # and the merge re-groups on (group cols..., __grouping_id)
+        p_names = p_names + ["__grouping_id"]
+        p_dtypes = p_dtypes + ["int"]
     partial_plan = AggregateNode(
         child=swap(agg.child), group_exprs=list(agg.group_exprs),
-        aggs=partial_specs, out_names=p_names, out_dtypes=p_dtypes)
+        aggs=partial_specs, rollup=agg.rollup,
+        out_names=p_names, out_dtypes=p_dtypes)
 
     def build_final(partials: MaterializedNode) -> PlanNode:
         """Re-aggregate the unioned partials, then restore A's schema."""
-        group_refs = [BCol(p_dtypes[i], i, p_names[i])
-                      for i in range(ngroups)]
+        nmerge = ngroups + (1 if agg.rollup else 0)   # + __grouping_id
+        gidx = list(range(ngroups))
+        if agg.rollup:
+            gidx.append(len(p_names) - 1)
+        group_refs = [BCol(p_dtypes[i], i, p_names[i]) for i in gidx]
         merge_specs: list[AggSpec] = []
         for spec, (kind, idxs) in zip(agg.aggs, recipes):
             if kind in ("min", "max"):
@@ -178,9 +193,9 @@ def try_streaming_plan(plan: PlanNode, est_rows, threshold: int
                 for j in idxs:
                     merge_specs.append(AggSpec(
                         "sum", BCol(p_dtypes[j], j), False, p_names[j]))
-        m_names = ([p_names[i] for i in range(ngroups)] +
+        m_names = ([p_names[i] for i in gidx] +
                    [s.name for s in merge_specs])
-        m_dtypes = ([p_dtypes[i] for i in range(ngroups)] +
+        m_dtypes = ([p_dtypes[i] for i in gidx] +
                     [s.dtype for s in merge_specs])
         merged = AggregateNode(child=partials, group_exprs=group_refs,
                                aggs=merge_specs,
@@ -188,7 +203,7 @@ def try_streaming_plan(plan: PlanNode, est_rows, threshold: int
         # project back to A's output schema
         exprs: list = [BCol(m_dtypes[i], i, m_names[i])
                        for i in range(ngroups)]
-        col = ngroups
+        col = nmerge
         for spec, (kind, idxs) in zip(agg.aggs, recipes):
             if kind in ("min", "max", "sum_int"):
                 exprs.append(BCol(spec.dtype, col))
@@ -206,6 +221,8 @@ def try_streaming_plan(plan: PlanNode, est_rows, threshold: int
                 n_ref = BCol("int", col + 1)
                 exprs.append(BCall("float", "div", [s_ref, n_ref]))
                 col += 2
+        if agg.rollup:     # __grouping_id is the LAST output column
+            exprs.append(BCol("int", ngroups, "__grouping_id"))
         return ProjectNode(merged, exprs, out_names=list(agg.out_names),
                            out_dtypes=list(agg.out_dtypes))
 

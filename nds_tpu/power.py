@@ -148,7 +148,8 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
                      strict: bool = False,
                      profile_folder: str | None = None,
                      fault_inject: list[str] | None = None,
-                     keep_sc: bool = False) -> list[tuple[str, int, int, int]]:
+                     keep_sc: bool = False,
+                     decimal: str | None = None) -> list[tuple[str, int, int, int]]:
     """Run every query in the stream; returns (name, start_ms, end_ms, ms).
 
     The CSV time log layout (query name, start, end, elapsed + the
@@ -173,6 +174,13 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
     maybe_enable_compile_cache()
     check_json_summary_folder(json_summary_folder)
     config = EngineConfig.from_property_file(property_file)
+    if decimal:
+        config.decimal_physical = decimal
+    if config.decimal_physical == "i64":
+        # exact scaled-int64 decimals need 64-bit lanes (spec-faithful
+        # measured configuration; reference DecimalType nds_schema.py:43-47)
+        from .config import enable_x64
+        enable_x64()
     session = Session(config)
     setup_tables(session, input_prefix, input_format)
 
@@ -270,6 +278,9 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--fault_inject", default=None,
                    help="comma-separated query names whose run raises an "
                         "injected fault (harness self-test)")
+    p.add_argument("--decimal", default=None, choices=["f64", "i64"],
+                   help="decimal physical type (i64 = exact scaled int64, "
+                        "the spec-faithful measured configuration)")
     a = p.parse_args(argv)
     sub = a.sub_queries.split(",") if a.sub_queries else None
     inject = a.fault_inject.split(",") if a.fault_inject else None
@@ -277,7 +288,8 @@ def main(argv: list[str] | None = None) -> int:
                      a.input_format, a.output_prefix, a.output_format,
                      a.json_summary_folder, sub, a.property_file, a.backend,
                      warmup=a.warmup, strict=a.strict,
-                     profile_folder=a.profile_folder, fault_inject=inject)
+                     profile_folder=a.profile_folder, fault_inject=inject,
+                     decimal=a.decimal)
     return 0
 
 

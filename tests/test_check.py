@@ -94,3 +94,24 @@ def test_report_finalize_and_stats(tmp_path):
     data = json.load(open(path))
     assert data["queryStatus"] == ["CompletedWithTaskFailures"]
     assert data["execStats"][0]["mode"] == "compiled"
+
+
+def test_ci_pipeline_script_runs():
+    """cicd/ci.yml must be backed by an EXECUTABLE pipeline (round-2
+    verdict #6): the native stage builds the generator and self-checks a
+    fixed-size table, and the workflow delegates every job to the script."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(repo, "cicd", "run_ci.sh")
+    out = subprocess.run(["bash", script, "--list"], capture_output=True,
+                         text=True, check=True)
+    assert out.stdout.split() == ["native", "test", "bench", "all"]
+    subprocess.run(["bash", script, "native"], check=True, timeout=600)
+    import yaml
+    with open(os.path.join(repo, "cicd", "ci.yml")) as f:
+        wf = yaml.safe_load(f)
+    assert set(wf["jobs"]) == {"native", "test", "bench"}
+    for job in wf["jobs"].values():
+        assert any("run_ci.sh" in str(step.get("run", ""))
+                   for step in job["steps"])

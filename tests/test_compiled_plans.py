@@ -104,7 +104,7 @@ def test_mesh_sharded_compiled_run():
     """8-virtual-device SPMD: fact scan row-sharded, plan GSPMD-partitioned."""
     import jax
 
-    cfg = EngineConfig(mesh_shape=(8,))
+    cfg = EngineConfig(mesh_shape=(8,), shard_min_rows=1024)
     s = star_session(n_fact=1 << 15)
     s.config = cfg
     s._jax_exec = None  # rebuild executor with the mesh

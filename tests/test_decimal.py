@@ -175,7 +175,7 @@ def test_out_of_core_decimal_streaming():
         "p": pa.array([D("1.25")] * n, type=pa.decimal128(7, 2)),
     })
     s = Session(EngineConfig(decimal_physical="i64", out_of_core=True,
-                             chunk_rows=512))
+                             chunk_rows=512, out_of_core_min_rows=1000))
     s.register_arrow("t", t, est_rows=n)
     s._est_rows["t"] = n
     r = s.sql("SELECT k, SUM(p) AS sp, COUNT(*) AS c FROM t GROUP BY k "

@@ -33,7 +33,8 @@ def make_session(tmp_path, out_of_core=True):
                     "grp": pa.array((np.arange(N_DIM) % 13).astype(np.int32))})
     path = os.path.join(str(tmp_path), "fact.parquet")
     pq.write_table(fact, path, row_group_size=8192)
-    cfg = EngineConfig(out_of_core=out_of_core, chunk_rows=CHUNK)
+    cfg = EngineConfig(out_of_core=out_of_core, chunk_rows=CHUNK,
+                       out_of_core_min_rows=10_000)
     s = Session(cfg)
     s.register_parquet("fact", path)
     s.register_arrow("dim", dim)
@@ -100,10 +101,18 @@ def test_eligibility_rules():
         plan("SELECT g, SUM(v) FROM big JOIN small ON big.k = small.k "
              "GROUP BY g"), est, 1 << 20)
     assert ok is not None and ok.big_table == "big"
-    # rollup not streamable
-    assert try_streaming_plan(
+    # rollup IS streamable (round-3: per-prefix partials merged on
+    # (group cols..., __grouping_id))
+    rp = try_streaming_plan(
         plan("SELECT k, SUM(v) FROM big GROUP BY ROLLUP(k)"),
-        est, 1 << 20) is None
+        est, 1 << 20)
+    assert rp is not None and rp.partial_plan.rollup
+    # windows ABOVE the aggregate are streamable (they run over merged
+    # partials in the final phase); windows BELOW it are not
+    assert try_streaming_plan(
+        plan("SELECT g, s, rank() OVER (ORDER BY s DESC) FROM "
+             "(SELECT g, SUM(v) s FROM big JOIN small ON big.k = small.k "
+             "GROUP BY g) t"), est, 1 << 20) is not None
     # big table on the build side of a right join: not streamable
     assert try_streaming_plan(
         plan("SELECT g, SUM(v) FROM big RIGHT JOIN small ON big.k = small.k "
@@ -115,3 +124,28 @@ def test_eligibility_rules():
         Planner(catalog2).plan_query(
             parse_sql("SELECT COUNT(*) FROM a JOIN b ON a.k = b.k")),
         {"a": 10_000_000, "b": 10_000_000}.get, 1 << 20) is None
+
+
+def test_streaming_rollup_matches_incore(tmp_path):
+    s = make_session(tmp_path)
+    q = ("SELECT d.grp, f.day % 2 AS parity, SUM(f.qty) AS sq, "
+         "COUNT(*) AS cnt FROM fact f JOIN dim d ON f.fk = d.dk "
+         "WHERE f.day < 120 GROUP BY ROLLUP(d.grp, f.day % 2) "
+         "ORDER BY d.grp, parity")
+    oracle = s.sql(q, backend="numpy")
+    streamed = s.sql(q, backend="jax")
+    assert s.last_exec_stats["mode"] == "streaming"
+    assert s.last_exec_stats.get("re_records", 0) == 0
+    assert sorted(rows_of(oracle), key=repr) == \
+        sorted(rows_of(streamed), key=repr)
+
+
+def test_streaming_window_above_agg(tmp_path):
+    s = make_session(tmp_path)
+    q = ("SELECT grp, sq, RANK() OVER (ORDER BY sq DESC) rk FROM "
+         "(SELECT d.grp AS grp, SUM(f.qty) AS sq FROM fact f "
+         "JOIN dim d ON f.fk = d.dk GROUP BY d.grp) t ORDER BY rk, grp")
+    oracle = s.sql(q, backend="numpy")
+    streamed = s.sql(q, backend="jax")
+    assert s.last_exec_stats["mode"] == "streaming"
+    assert rows_of(oracle) == rows_of(streamed)

@@ -503,8 +503,11 @@ static void generic_value(const TableDef& t, int ci, int64_t row,
         if (ends_with(n, "_division_id") || ends_with(n, "_company_id") ||
             !strcmp(n, "cc_division") || !strcmp(n, "cc_company")) { L.i(1 + (int64_t)(r % 6)); return; }
         if (ends_with(n, "_time")) { L.i((int64_t)(r % 86400)); return; }
-        if (ends_with(n, "_quantity") || ends_with(n, "_qty") ||
-            ends_with(n, "_qty_on_hand") || ends_with(n, "quantity_on_hand")) { L.i((int64_t)(r % 1000)); return; }
+        if (ends_with(n, "_qty_on_hand") ||
+            ends_with(n, "quantity_on_hand")) { L.i((int64_t)(r % 1000)); return; }
+        // order/lineitem quantities are <= 100 per spec; larger values
+        // overflow DECIMAL(7,2) ext_* products in the LF_* insert views
+        if (ends_with(n, "_quantity") || ends_with(n, "_qty")) { L.i(1 + (int64_t)(r % 100)); return; }
         L.i(1 + (int64_t)(r % 1000));
         return;
     }

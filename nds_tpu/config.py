@@ -50,7 +50,10 @@ class EngineConfig:
     # split each sufficiently large CTE subtree into its own XLA program
     # whose output stays device-resident (bounds q4-class compile times and
     # shares materialized CTEs across q14/q23 parts). 0 disables.
-    segment_plan_nodes: int = 40
+    # 18: every CTE-bearing NDS plan with a >= 8-node CTE segments — the
+    # whole-plan compile pathology (q4/q11/q74 year_total class) scales
+    # with the CTE body, not the total node count
+    segment_plan_nodes: int = 18
     segment_min_cte_nodes: int = 8
     # device-resident segment outputs kept before LRU eviction
     segment_cache_entries: int = 16

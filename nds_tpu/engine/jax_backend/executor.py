@@ -148,7 +148,7 @@ class JaxExecutor:
                  jit_plans: bool = True,
                  mesh=None,
                  shard_min_rows: int = 1 << 18,
-                 segment_plan_nodes: int = 40,
+                 segment_plan_nodes: int = 18,
                  segment_min_cte_nodes: int = 8,
                  segment_cache_entries: int = 16,
                  scan_budget_bytes: int = 10 << 30):

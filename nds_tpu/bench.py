@@ -131,6 +131,15 @@ def run_full_bench(cfg: dict) -> dict:
     stream_dir = cfg["generate_query_stream"]["stream_path"]
     report_dir = cfg.get("report_dir", "./nds_report")
     backend = cfg.get("backend")
+    decimal = cfg.get("decimal")
+    if decimal and decimal not in ("f64", "i64"):
+        raise ValueError(f"bench config: unknown decimal {decimal!r} "
+                         "(expected f64 or i64)")
+    if decimal == "i64" and not cfg["load_test"].get("use_decimal", False):
+        raise ValueError(
+            "bench config: decimal: i64 requires load_test.use_decimal: true"
+            " — an f64-loaded warehouse has no decimal columns to bind, so"
+            " the run would silently measure f64")
     sub_queries = cfg.get("sub_queries")
     input_format = cfg["load_test"].get("format", "parquet")
 
@@ -173,7 +182,8 @@ def run_full_bench(cfg: dict) -> dict:
                              "json_summary_folder"),
                          sub_queries=sub_queries,
                          property_file=power_cfg.get("property_file"),
-                         backend=backend)
+                         backend=backend, decimal=decimal,
+                         warmup=int(power_cfg.get("warmup", 0)))
     t_power = get_power_time(power_log)
 
     # steps 4+6: throughput rounds; steps 5+7: maintenance rounds
@@ -187,7 +197,9 @@ def run_full_bench(cfg: dict) -> dict:
             run_throughput(warehouse, stream_dir, ids, report_dir,
                            input_format=input_format,
                            sub_queries=sub_queries, backend=backend,
-                           mode=tt_cfg.get("mode", "process"))
+                           mode=tt_cfg.get("mode", "process"),
+                           warmup=int(tt_cfg.get("warmup", 0)),
+                           decimal=decimal)
         t_tt[rnd] = throughput_elapsed(
             [stream_log_path(report_dir, s) for s in ids])
         dm_total = 0.0
@@ -196,7 +208,7 @@ def run_full_bench(cfg: dict) -> dict:
             if not _skip(dm_cfg):
                 maintenance.run_maintenance(
                     warehouse, _refresh_dir(data_path, s), dm_log,
-                    backend=backend)
+                    backend=backend, decimal=decimal)
             dm_total += get_maintenance_time(dm_log)
         t_dm[rnd] = dm_total
 

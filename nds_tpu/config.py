@@ -114,6 +114,21 @@ def enable_x64() -> None:
     jax.config.update("jax_enable_x64", True)
 
 
+def apply_decimal(config: "EngineConfig", decimal: str | None) -> None:
+    """Apply a runner-level decimal override and its preconditions.
+
+    i64 (exact scaled-int64 decimals, the spec-faithful measured
+    configuration; reference DecimalType nds_schema.py:43-47) needs 64-bit
+    lanes. One shared helper so every runner enforces the same rules."""
+    if decimal:
+        if decimal not in ("f64", "i64"):
+            raise ValueError(f"unknown decimal physical type {decimal!r} "
+                             "(expected f64 or i64)")
+        config.decimal_physical = decimal
+    if config.decimal_physical == "i64":
+        enable_x64()
+
+
 def maybe_enable_compile_cache() -> None:
     """Default-on persistent compile cache for every runner (power,
     throughput, maintenance, orchestrator) — the reference reuses Spark's

@@ -80,12 +80,15 @@ def register_staging(session: Session, refresh_dir: str) -> None:
 def run_maintenance(warehouse_path: str, refresh_dir: str, time_log: str,
                     maintenance_queries: list[str] | None = None,
                     json_summary_folder: str | None = None,
-                    backend: str | None = None
+                    backend: str | None = None,
+                    decimal: str | None = None
                     ) -> list[tuple[str, int, int, int]]:
     from .config import maybe_enable_compile_cache
 
     maybe_enable_compile_cache()
     config = EngineConfig()
+    from .config import apply_decimal
+    apply_decimal(config, decimal)
     session = Session(config)
     wh = Warehouse(warehouse_path)
     session.attach_warehouse(wh)
@@ -140,11 +143,12 @@ def main(argv: list[str] | None = None) -> int:
                    help="comma-separated subset of LF_*/DF_* functions")
     p.add_argument("--json_summary_folder", default=None)
     p.add_argument("--backend", default=None, choices=["jax", "numpy"])
+    p.add_argument("--decimal", default=None, choices=["f64", "i64"])
     a = p.parse_args(argv)
     funcs = (a.maintenance_queries.split(",") if a.maintenance_queries
              else None)
     run_maintenance(a.warehouse_path, a.refresh_dir, a.time_log, funcs,
-                    a.json_summary_folder, a.backend)
+                    a.json_summary_folder, a.backend, a.decimal)
     return 0
 
 

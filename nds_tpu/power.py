@@ -174,13 +174,8 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
     maybe_enable_compile_cache()
     check_json_summary_folder(json_summary_folder)
     config = EngineConfig.from_property_file(property_file)
-    if decimal:
-        config.decimal_physical = decimal
-    if config.decimal_physical == "i64":
-        # exact scaled-int64 decimals need 64-bit lanes (spec-faithful
-        # measured configuration; reference DecimalType nds_schema.py:43-47)
-        from .config import enable_x64
-        enable_x64()
+    from .config import apply_decimal
+    apply_decimal(config, decimal)
     session = Session(config)
     setup_tables(session, input_prefix, input_format)
 

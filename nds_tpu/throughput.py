@@ -35,11 +35,13 @@ def _stream_cmd(input_prefix: str, stream_file: str, time_log: str,
                 json_summary_folder: str | None,
                 sub_queries: list[str] | None,
                 property_file: str | None, backend: str | None,
-                warmup: int = 0) -> list[str]:
+                warmup: int = 0, decimal: str | None = None) -> list[str]:
     cmd = [sys.executable, "-m", "nds_tpu.power", input_prefix, stream_file,
            time_log, "--input_format", input_format]
     if warmup:
         cmd += ["--warmup", str(warmup)]
+    if decimal:
+        cmd += ["--decimal", decimal]
     if output_prefix:
         cmd += ["--output_prefix", output_prefix]
     if json_summary_folder:
@@ -62,7 +64,7 @@ def run_throughput(input_prefix: str, stream_dir: str, streams: list[int],
                    property_file: str | None = None,
                    backend: str | None = None,
                    mode: str = "process",
-                   warmup: int = 0) -> float:
+                   warmup: int = 0, decimal: str | None = None) -> float:
     """Run the given streams concurrently; returns elapsed seconds.
 
     Elapsed is max(stream Power End) - min(stream Power Start) over the
@@ -81,7 +83,7 @@ def run_throughput(input_prefix: str, stream_dir: str, streams: list[int],
         procs = [subprocess.Popen(
             _stream_cmd(input_prefix, sf, log, input_format, out,
                         json_summary_folder, sub_queries, property_file,
-                        backend, warmup))
+                        backend, warmup, decimal))
             for sf, log, out in jobs]
         failed = [p.args for p in procs if p.wait() != 0]
         if failed:
@@ -93,7 +95,7 @@ def run_throughput(input_prefix: str, stream_dir: str, streams: list[int],
                 input_format=input_format, output_prefix=out,
                 json_summary_folder=json_summary_folder,
                 sub_queries=sub_queries, property_file=property_file,
-                backend=backend, warmup=warmup)
+                backend=backend, warmup=warmup, decimal=decimal)
                 for sf, log, out in jobs]
             for f in futures:
                 f.result()
@@ -138,13 +140,14 @@ def main(argv: list[str] | None = None) -> int:
                    choices=["process", "thread"])
     p.add_argument("--warmup", type=int, default=0,
                    help="untimed pre-runs per query in each stream")
+    p.add_argument("--decimal", default=None, choices=["f64", "i64"])
     a = p.parse_args(argv)
     ids = [int(s) for s in a.streams.split(",")]
     sub = a.sub_queries.split(",") if a.sub_queries else None
     elapsed = run_throughput(a.input_prefix, a.stream_dir, ids,
                              a.time_log_dir, a.input_format, a.output_prefix,
                              a.json_summary_folder, sub, a.property_file,
-                             a.backend, a.mode, a.warmup)
+                             a.backend, a.mode, a.warmup, a.decimal)
     print(f"Throughput Test Time: {elapsed:.3f} seconds")
     return 0
 

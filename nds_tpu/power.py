@@ -232,22 +232,32 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
         if json_summary_folder:
             report.write_summary(
                 name, prefix=os.path.join(json_summary_folder, "power"))
+        # flush the partial log after every query: a multi-hour stream
+        # interrupted mid-run keeps its measurements (sentinel rows are
+        # appended only by the completed run below)
+        _write_time_log(time_log, power_start, rows, None)
     power_end = int(time.time() * 1000)
-
-    os.makedirs(os.path.dirname(time_log) or ".", exist_ok=True)
-    with open(time_log, "w", newline="") as f:
-        w = csv.writer(f)
-        w.writerow(["query", "start_time", "end_time", "time"])
-        w.writerow(["Power Start Time", power_start, "", ""])
-        for r in rows:
-            w.writerow(r)
-        w.writerow(["Power End Time", power_end, "", ""])
-        w.writerow(["Power Test Time", "", "", power_end - power_start])
+    _write_time_log(time_log, power_start, rows, power_end)
     if strict and fallback_queries:
         raise RuntimeError(
             "device fallbacks in strict mode: " + "; ".join(
                 f"{q}: {fbs}" for q, fbs in fallback_queries.items()))
     return rows
+
+
+def _write_time_log(time_log: str, power_start: int, rows, power_end) -> None:
+    os.makedirs(os.path.dirname(time_log) or ".", exist_ok=True)
+    tmp = time_log + ".tmp"
+    with open(tmp, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["query", "start_time", "end_time", "time"])
+        w.writerow(["Power Start Time", power_start, "", ""])
+        for r in rows:
+            w.writerow(r)
+        if power_end is not None:
+            w.writerow(["Power End Time", power_end, "", ""])
+            w.writerow(["Power Test Time", "", "", power_end - power_start])
+    os.replace(tmp, time_log)   # atomic: an interrupt never truncates
 
 
 def main(argv: list[str] | None = None) -> int:

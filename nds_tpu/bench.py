@@ -50,6 +50,7 @@ def get_stream_range(num_streams: int, first_or_second: int) -> list[int]:
 
 
 def get_load_time(report_path: str) -> float:
+    _require_report(report_path, "load_test")
     with open(report_path) as f:
         for line in f:
             if line.startswith("Load Test Time:"):
@@ -66,7 +67,18 @@ def get_load_end_timestamp(report_path: str) -> int:
     raise ValueError(f"no RNGSEED in {report_path}")
 
 
+def _require_report(path: str, phase: str):
+    """Clear failure when a skipped phase's report is absent: skip means
+    'already ran' (restartable split runs, reference bench.yml skip flags) —
+    point the config at the prior run's report_dir or unskip the phase."""
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"{phase} report {path!r} is missing: the phase was skipped but "
+            f"never ran — unskip it or reuse a report_dir that has it")
+
+
 def get_power_time(time_log: str) -> float:
+    _require_report(time_log, "power_test")
     with open(time_log) as f:
         for row in csv.reader(f):
             if row and row[0] == "Power Test Time":
@@ -76,6 +88,7 @@ def get_power_time(time_log: str) -> float:
 
 def get_maintenance_time(time_log: str) -> float:
     """Sum of refresh-function times, seconds (nds_bench.py:176-196)."""
+    _require_report(time_log, "maintenance_test")
     total_ms = 0
     seen = False
     with open(time_log) as f:
@@ -200,8 +213,10 @@ def run_full_bench(cfg: dict) -> dict:
                            mode=tt_cfg.get("mode", "process"),
                            warmup=int(tt_cfg.get("warmup", 0)),
                            decimal=decimal)
-        t_tt[rnd] = throughput_elapsed(
-            [stream_log_path(report_dir, s) for s in ids])
+        tt_logs = [stream_log_path(report_dir, s) for s in ids]
+        for lg in tt_logs:
+            _require_report(lg, "throughput_test")
+        t_tt[rnd] = throughput_elapsed(tt_logs)
         dm_total = 0.0
         for s in ids:
             dm_log = os.path.join(report_dir, f"maintenance_{s}.csv")

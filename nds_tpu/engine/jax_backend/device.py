@@ -151,6 +151,23 @@ def to_device(table: Table, capacity: Optional[int] = None,
     return DTable(list(table.names), cols, put(alive))
 
 
+def free_dtable(dt: Optional[DTable]) -> None:
+    """Explicitly release a DTable's device buffers.
+
+    Dropping the Python reference leaves freeing to gc timing, and tunneled
+    platforms can pin uploads client-side — streaming loops that rebind a
+    morsel buffer hundreds of times must free eagerly or accumulate the
+    whole scan on the host."""
+    if dt is None:
+        return
+    for leaf in jax.tree_util.tree_leaves(dt):
+        if hasattr(leaf, "delete"):
+            try:
+                leaf.delete()
+            except Exception:
+                pass
+
+
 def to_host(dt: DTable, count: Optional[int] = None) -> Table:
     """Materialize a device table back into a host Table (compacted).
 

@@ -298,7 +298,7 @@ class Session:
         not streamable."""
         from . import streaming
         from .jax_backend import JaxExecutor, to_host
-        from .jax_backend.device import bucket, to_device
+        from .jax_backend.device import bucket, free_dtable, to_device
         from .jax_backend.executor import CompiledQuery, ReplayMismatch
 
         if self._stream_cache_gen != self._generation:
@@ -360,6 +360,7 @@ class Session:
                     if k.startswith(streaming.MORSEL_TABLE + "//"))
             cq, ent, mkey = sent["cq"], sent["ent"], sent["mkey"]
             cols = mkey.split("//", 1)[1].split(",")
+            free_dtable(jexec._scan_cache.get(mkey))
             jexec._scan_cache[mkey] = to_device(morsel.select(cols),
                                                 capacity=cap)
             try:
@@ -370,8 +371,8 @@ class Session:
                 # evicting the PREVIOUS morsel from the record-side scan
                 # cache (split from the replay cache on accelerator/mesh
                 # backends), or the eager pass would re-aggregate stale rows
-                jexec._scan_cache_rec.pop(mkey, None)
-                jexec._scan_cache.pop(mkey, None)
+                free_dtable(jexec._scan_cache_rec.pop(mkey, None))
+                free_dtable(jexec._scan_cache.pop(mkey, None))
                 out, _, _ = jexec.record_plan(sp.partial_plan)
                 re_records += 1
             partials.append(arrow_bridge.to_arrow(to_host(out)))
@@ -379,8 +380,8 @@ class Session:
         # free the final morsel: the cached executor must not pin a
         # chunk_rows-capacity device buffer (or the host morsel) per query
         if sent["mkey"] is not None:
-            jexec._scan_cache.pop(sent["mkey"], None)
-            jexec._scan_cache_rec.pop(sent["mkey"], None)
+            free_dtable(jexec._scan_cache.pop(sent["mkey"], None))
+            free_dtable(jexec._scan_cache_rec.pop(sent["mkey"], None))
         current.pop("table", None)
 
         if not partials:

@@ -60,11 +60,10 @@ def replace_date(statements: str, pair: tuple[str, str]) -> str:
 
 
 def load_function_sql(func: str) -> str:
+    from .power import strip_sql_comments
+
     with open(os.path.join(SQL_DIR, f"{func}.sql")) as f:
-        # strip comment lines; the engine parser takes statement text
-        lines = [ln for ln in f.read().splitlines()
-                 if not ln.strip().startswith("--")]
-    return "\n".join(lines)
+        return strip_sql_comments(f.read())
 
 
 def register_staging(session: Session, refresh_dir: str) -> None:

@@ -49,8 +49,16 @@ def gen_sql_from_stream(stream_text: str) -> "OrderedDict[str, str]":
     return queries
 
 
+def strip_sql_comments(sql: str) -> str:
+    """Drop full '--' comment lines: a ';' inside a template header comment
+    (query93) must never reach the naive statement split used by the
+    runners and the bench."""
+    return "\n".join(ln for ln in sql.splitlines()
+                     if not ln.lstrip().startswith("--"))
+
+
 def _emit(queries, number, lines):
-    sql = "\n".join(lines).strip()
+    sql = strip_sql_comments("\n".join(lines)).strip()
     name = f"query{number}"
     if number in SPECIAL_TEMPLATES:
         for part_name, part_sql in split_special_query(name, sql):
@@ -120,6 +128,7 @@ def ensure_valid_column_names(names: list[str]) -> list[str]:
 def run_one_query(session: Session, sql: str, query_name: str,
                   output_prefix: str | None, output_format: str,
                   backend: str | None = None):
+    sql = strip_sql_comments(sql)   # callers may pass raw template text
     statements = [s for s in sql.split(";") if s.strip()]
     result = None
     for stmt in statements:

@@ -587,6 +587,28 @@ class JaxExecutor:
     def _eval(self, expr: BExpr, table: DTable) -> DCol:
         return jexprs.evaluate(expr, table, subquery_eval=self._scalar)
 
+    def _dense_rank(self, key_data: list, key_valid: list,
+                    alive) -> tuple:
+        """dense_rank with record-time fast-tier selection (kernels.group_tier):
+        direct-address scatter or packed single-key sort replace the
+        multi-operand lax.sort when the key domain fits. Static gates keep
+        record and replay on the same schedule; the mesh path stays on the
+        generic kernel (scatter/cumsum over a replicated domain table would
+        force GSPMD gathers)."""
+        n = int(alive.shape[0])
+        if (self._mesh is None and key_data and n >= (1 << 13)
+                and all(jnp.issubdtype(d.dtype, jnp.integer)
+                        for d in key_data)):
+            limit = kernels.direct_limit(n)
+            tier = self._decide_exact_lazy(
+                lambda: kernels.group_tier(key_data, key_valid, alive, limit))
+            if tier == 1:
+                return kernels.dense_rank_direct(key_data, key_valid, alive,
+                                                 limit)
+            if tier == 2:
+                return kernels.dense_rank_packsort(key_data, key_valid, alive)
+        return kernels.dense_rank(key_data, key_valid, alive)
+
     def _scalar(self, plan: PlanNode):
         """Uncorrelated scalar subquery -> (value, validity).
 
@@ -723,7 +745,7 @@ class JaxExecutor:
         is_left = iota < lcap
         keys = [rank_key(c) for c in both.cols]
         valids = [c.valid for c in both.cols]
-        gid, _ = kernels.dense_rank(keys, valids, both.alive)
+        gid, _ = self._dense_rank(keys, valids, both.alive)
         safe_gid = jnp.where(both.alive, gid, n)
         in_left = jnp.zeros(n + 1, bool).at[
             jnp.where(is_left, safe_gid, n)].set(True)
@@ -788,7 +810,7 @@ class JaxExecutor:
     def _distinct_alive(self, t: DTable, col_idx: list[int]) -> jax.Array:
         keys = [rank_key(t.cols[i]) for i in col_idx]
         valids = [t.cols[i].valid for i in col_idx]
-        gid, _ = kernels.dense_rank(keys, valids, t.alive)
+        gid, _ = self._dense_rank(keys, valids, t.alive)
         n = t.capacity
         iota = jnp.arange(n, dtype=_I32)
         first = jnp.full(n + 1, n, dtype=_I32).at[
@@ -806,8 +828,8 @@ class JaxExecutor:
     def _window_one(self, wf: WindowFunc, child: DTable) -> DCol:
         n = child.capacity
         pcols = [self._eval(e, child) for e in wf.partition_by]
-        gid, _ = kernels.dense_rank([rank_key(c) for c in pcols],
-                                    [c.valid for c in pcols], child.alive)
+        gid, _ = self._dense_rank([rank_key(c) for c in pcols],
+                                  [c.valid for c in pcols], child.alive)
         arg_col = None if wf.arg is None else self._eval(wf.arg, child)
         if arg_col is not None and arg_col.dtype == "str":
             raise NotImplementedError("window function over strings (device)")
@@ -1082,7 +1104,7 @@ class JaxExecutor:
                        keep: list[int]) -> DTable:
         group_cols = [self._eval(e, child) for e in node.group_exprs]
         active = [group_cols[i] for i in keep]
-        gid, num_groups_t = kernels.dense_rank(
+        gid, num_groups_t = self._dense_rank(
             [rank_key(c) for c in active], [c.valid for c in active],
             child.alive)
         num_groups = self._decide_cap(num_groups_t)
@@ -1242,7 +1264,7 @@ class JaxExecutor:
             key_data.append(jnp.concatenate([ld, rd]))
         match_alive = jnp.concatenate([left.alive & lvalid,
                                        right.alive & rvalid])
-        gid, _ = kernels.dense_rank(
+        gid, _ = self._dense_rank(
             key_data, [jnp.ones(lcap + rcap, bool)] * len(key_data),
             match_alive)
         l_gid, r_gid = gid[:lcap], gid[lcap:]

@@ -149,9 +149,11 @@ def main() -> None:
 
 
 def scan_volume(session, sqls: list[str]) -> tuple[int, int]:
-    """(rows, bytes) the timed queries scan: distinct (table, column) sizes
-    from the planned ScanNodes — a lower bound of HBM traffic, giving a
-    host-load-independent roofline fraction."""
+    """(rows, bytes) the timed queries scan, SUMMED PER QUERY: each compiled
+    query re-reads its resident scan columns from HBM, so per-query bytes
+    add across the subset (columns deduped within one query only — a lower
+    bound of HBM traffic, giving a host-load-independent roofline
+    fraction)."""
     import jax
 
     from nds_tpu.sql import parse_sql
@@ -161,9 +163,11 @@ def scan_volume(session, sqls: list[str]) -> tuple[int, int]:
     x64 = jax.config.read("jax_enable_x64")
     wide = 8 if x64 else 4
     size = {"int": wide, "float": wide, "bool": 1, "date": 4, "str": 4}
-    tables: set[str] = set()
-    cols: dict[tuple[str, str], int] = {}
+    rows = 0
+    total_bytes = 0
     for sql in sqls:
+        tables: set[str] = set()
+        cols: dict[tuple[str, str], int] = {}
         for stmt in (x for x in sql.split(";") if x.strip()):
             plan = Planner(session._catalog()).plan_query(parse_sql(stmt))
             for node in iter_plan_nodes(plan):
@@ -173,8 +177,9 @@ def scan_volume(session, sqls: list[str]) -> tuple[int, int]:
                 n = session._est_rows.get(node.table, 0)
                 for c, d in zip(node.columns, node.out_dtypes):
                     cols[(node.table, c)] = n * size.get(d, wide)
-    rows = sum(session._est_rows.get(t, 0) for t in tables)
-    return rows, sum(cols.values())
+        rows += sum(session._est_rows.get(t, 0) for t in tables)
+        total_bytes += sum(cols.values())
+    return rows, total_bytes
 
 
 if __name__ == "__main__":

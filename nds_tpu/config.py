@@ -143,8 +143,15 @@ def maybe_enable_compile_cache() -> None:
         # explicit default path: enable_compile_cache(None) would re-read
         # the env var and mint a directory literally named after the token
         path = os.path.join(os.path.expanduser("~"), ".cache", "nds_tpu_xla")
-    else:
+    elif os.sep in raw or (os.altsep and os.altsep in raw) or \
+            raw.startswith(("~", ".")):
         path = raw           # case-preserved custom directory
+    else:
+        # a bare unrecognized token ('2', 'enabled') is almost certainly a
+        # typo'd boolean — erroring beats minting a directory of that name
+        raise ValueError(
+            f"NDS_TPU_COMPILE_CACHE={raw!r}: use 0/1/true/false/on/off, "
+            "or a directory path (must contain a path separator)")
     enable_compile_cache(path)
 
 

@@ -132,7 +132,8 @@ class Executor:
         agg_args = [None if a.arg is None else self._eval(a.arg, child)
                     for a in node.aggs]
         g_out, a_out, gid_col = ops.aggregate(child, group_cols, node.aggs,
-                                              agg_args, rollup=node.rollup)
+                                              agg_args, rollup=node.rollup,
+                                              levels=node.rollup_levels)
         cols = g_out + a_out
         if node.rollup:
             cols.append(gid_col)

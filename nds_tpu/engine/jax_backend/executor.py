@@ -37,7 +37,7 @@ from ..plan import (
     replace_plan_nodes,
 )
 from . import jexprs, kernels
-from .device import (DCol, DTable, bucket, phys_dtype, rank_key,
+from .device import (DCol, DTable, bucket, free_dtable, phys_dtype, rank_key,
                      string_rank_lut, to_device, to_host)
 
 _I32 = jnp.int32
@@ -272,11 +272,23 @@ class JaxExecutor:
     def _segment_plan(self, plan: PlanNode) -> list:
         """Split a big plan into [(seg_key, unit_plan)...] + [(None, root)].
 
-        Units are in dependency order (CTE definition order is topological);
-        a later unit sees earlier CTEs as VirtualScanNodes resolved against
-        the segment cache. Small plans return [(None, plan)] untouched."""
+        Two cut classes, both yielding bounded XLA programs:
+        - CTE boundaries (planner-fingerprinted, shared across statements);
+        - rollup grouping-set boundaries (q67-class plans have no CTEs but
+          compile one giant program per grouping set: the aggregate's child
+          materializes once and each rollup level becomes its own unit).
+        Units are in dependency order; a later unit sees earlier outputs as
+        VirtualScanNodes resolved against the segment cache."""
+        if not self._jit_plans or self._seg_plan_nodes <= 0:
+            return [(None, plan)]
+        out = []
+        for seg_key, uplan in self._cte_units(plan):
+            out.extend(self._rollup_units(seg_key, uplan))
+        return out
+
+    def _cte_units(self, plan: PlanNode) -> list:
         segs = getattr(plan, "cte_segments", None)
-        if not segs or not self._jit_plans or self._seg_plan_nodes <= 0:
+        if not segs:
             return [(None, plan)]
         nodes = list(iter_plan_nodes(plan))
         if len(nodes) < self._seg_plan_nodes:
@@ -305,6 +317,58 @@ class JaxExecutor:
         units.append((None, replace_plan_nodes(plan, mapping)))
         return units
 
+    def _rollup_units(self, seg_key, uplan: PlanNode) -> list:
+        """Split big rollup aggregates in one compile unit into per-level
+        units: [(child_seg, child), (level_seg, level_agg)..., (seg_key,
+        rewritten)]. The rewrite unions per-level VirtualScans, which is
+        exactly the concat the in-program rollup performs."""
+        nodes = list(iter_plan_nodes(uplan))
+        if len(nodes) < self._seg_plan_nodes:
+            return [(seg_key, uplan)]
+        units: list = []
+        mapping: dict[int, PlanNode] = {}
+        cands = [n for n in nodes
+                 if isinstance(n, AggregateNode) and n.rollup
+                 and n.rollup_levels is None and len(n.group_exprs) >= 2]
+        # innermost first: a rollup nested in another rollup's child must be
+        # rewritten before the outer child unit is cut, or the outer unit
+        # would still compile the inner one as a giant in-program rollup
+        cands.sort(key=lambda a: sum(1 for _ in iter_plan_nodes(a)))
+        for orig in cands:
+            child_nodes = list(iter_plan_nodes(orig.child))
+            if len(child_nodes) < self._seg_min_cte or \
+                    any(isinstance(m, MaterializedNode) for m in child_nodes):
+                continue
+            child = replace_plan_nodes(orig.child, mapping) if mapping \
+                else orig.child
+            agg = dataclasses.replace(orig, child=child) if child \
+                is not orig.child else orig
+            ckey = "seg:" + _plan_fingerprint(child)
+            virt_child = VirtualScanNode(
+                key=ckey, label="rollup-src",
+                out_names=list(child.out_names),
+                out_dtypes=list(child.out_dtypes))
+            units.append((ckey, child))
+            branches: list[PlanNode] = []
+            for lvl in range(len(agg.group_exprs), -1, -1):
+                lnode = dataclasses.replace(agg, child=virt_child,
+                                            rollup_levels=[lvl])
+                lkey = "seg:" + _plan_fingerprint(lnode)
+                units.append((lkey, lnode))
+                branches.append(VirtualScanNode(
+                    key=lkey, label=f"rollup-lvl{lvl}",
+                    out_names=list(agg.out_names),
+                    out_dtypes=list(agg.out_dtypes)))
+            chain = branches[0]
+            for v in branches[1:]:
+                chain = SetOpNode(op="union", all=True, left=chain, right=v,
+                                  out_names=list(agg.out_names),
+                                  out_dtypes=list(agg.out_dtypes))
+            mapping[id(orig)] = chain     # keyed by the ORIGINAL node id
+        if not mapping:
+            return [(seg_key, uplan)]
+        return units + [(seg_key, replace_plan_nodes(uplan, mapping))]
+
     def _bind_segment(self, seg_key: str, out: DTable) -> None:
         """Stash a segment output for downstream units; LRU-bounded."""
         if self.last_stats.get("mode") in ("compiled", "compile+run"):
@@ -323,7 +387,9 @@ class JaxExecutor:
         while len(self._segment_lru) > self._seg_cache_entries and evictable:
             old = evictable.pop(0)
             self._segment_lru.remove(old)
-            self._scan_cache.pop(old, None)
+            # free eagerly: tunneled platforms pin buffers until gc, so a
+            # dropped reference alone would not reclaim HBM promptly
+            free_dtable(self._scan_cache.pop(old, None))
             self._resident.pop(old, None)
             if self._scan_cache_rec is not self._scan_cache:
                 self._scan_cache_rec.pop(old, None)
@@ -472,7 +538,9 @@ class JaxExecutor:
             if old == key or old in pinned:
                 continue
             total -= self._resident.pop(old)
-            self._scan_cache.pop(old, None)
+            # evicted entries are unpinned and not inputs of the in-flight
+            # run: free their device buffers now (see free_dtable rationale)
+            free_dtable(self._scan_cache.pop(old, None))
             if old in self._segment_lru:
                 self._segment_lru.remove(old)
 
@@ -869,10 +937,13 @@ class JaxExecutor:
     # -- aggregate -----------------------------------------------------------
     def _run_aggregate(self, node: AggregateNode) -> DTable:
         child = self.execute(node.child)
-        grouping_sets = [list(range(len(node.group_exprs)))]
-        if node.rollup:
+        if node.rollup_levels is not None:
+            grouping_sets = [list(range(k)) for k in node.rollup_levels]
+        elif node.rollup:
             grouping_sets = [list(range(k))
                              for k in range(len(node.group_exprs), -1, -1)]
+        else:
+            grouping_sets = [list(range(len(node.group_exprs)))]
         pieces = [self._aggregate_one_sharded(node, child, keep)
                   if self._mesh_agg_eligible(node, keep)
                   else self._aggregate_one(node, child, keep)
@@ -1222,10 +1293,11 @@ class JaxExecutor:
         kind = node.kind
         # Every anti branch below consults null_aware only when residual is
         # None; the combination is planner-rejected (planner.py _decorrelate)
-        # — assert so a future planner change can't silently keep rows that
-        # NOT IN semantics exclude.
-        assert not (node.null_aware and node.residual is not None), \
-            "null-aware anti join with residual is unsupported"
+        # — a real raise (assert strips under -O) so a future planner change
+        # can't silently keep rows that NOT IN semantics exclude.
+        if node.null_aware and node.residual is not None:
+            raise NotImplementedError(
+                "null-aware anti join with residual is unsupported")
         lcap, rcap = left.capacity, right.capacity
         if kind == "cross":
             lo = jnp.zeros(lcap, _I32)
@@ -1437,6 +1509,49 @@ class JaxExecutor:
             out = DTable(out.names, out.cols,
                          kernels.filter_alive(out.alive, mask.data, mask.valid))
         return out, left_idx, right_rows
+
+
+# -- plan utilities -----------------------------------------------------------
+
+def _plan_fingerprint(node) -> str:
+    """Stable structural hash of a plan subtree (for executor-synthesized
+    segment keys; CTE segments use planner AST fingerprints instead). Two
+    structurally identical subtrees — including literals, so stream-
+    parameterized plans never collide — share a segment cache slot.
+    MaterializedNodes hash by identity (callers exclude them)."""
+    import dataclasses as _dc
+    import hashlib
+
+    parts: list[str] = []
+
+    def rec(x):
+        if isinstance(x, MaterializedNode):
+            parts.append(f"mat:{id(x)}")
+            return
+        if isinstance(x, np.ndarray):
+            # repr truncates long arrays -> collision risk; hash content
+            parts.append(f"nd{x.dtype}{x.shape}:" + (
+                repr(x.tolist()) if x.dtype == object
+                else hashlib.sha1(x.tobytes()).hexdigest()))
+            return
+        if _dc.is_dataclass(x) and not isinstance(x, type):
+            parts.append(type(x).__name__ + "(")
+            for f in _dc.fields(x):
+                parts.append(f.name + "=")
+                rec(getattr(x, f.name))
+                parts.append(",")
+            parts.append(")")
+        elif isinstance(x, (list, tuple)):
+            parts.append("[")
+            for v in x:
+                rec(v)
+                parts.append(",")
+            parts.append("]")
+        else:
+            parts.append(repr(x))
+
+    rec(node)
+    return hashlib.sha1("".join(parts).encode()).hexdigest()[:16]
 
 
 # -- expression utilities -----------------------------------------------------

@@ -307,13 +307,32 @@ def agg_apply(gid: jax.Array, alive: jax.Array, func: str, arg,
         vals = jnp.where(cnt > 0, vals, jnp.zeros((), data.dtype))
         return vals, cnt > 0
     if func == "avg":
-        z = jnp.where(contrib, data, jnp.zeros((), data.dtype)).astype(
-            _float_dtype())
-        s = _seg(z, gid, cap_out, "sum")
-        return s / jnp.maximum(cnt, 1).astype(_float_dtype()), cnt > 0
+        # integer/decimal inputs under x64: sum EXACTLY in int64 and divide
+        # on the tiny per-group output — a per-row f64 cast would run the
+        # whole segment reduction in software-emulated f64 on TPU (measured
+        # dominant in avg-heavy plans like q9/q22). x32 keeps the float
+        # path: i32 sums would wrap past 2^31 on big groups.
+        if jnp.issubdtype(data.dtype, jnp.integer) and \
+                jax.config.read("jax_enable_x64"):
+            z = jnp.where(contrib, data, jnp.zeros((), data.dtype))
+            s = _seg(z, gid, cap_out, "sum")
+        else:
+            z = jnp.where(contrib, data, jnp.zeros((), data.dtype)).astype(
+                _float_dtype())
+            s = _seg(z, gid, cap_out, "sum")
+        return (s.astype(_float_dtype()) /
+                jnp.maximum(cnt, 1).astype(_float_dtype())), cnt > 0
     if func == "stddev_samp":
+        # the squares must accumulate in float (i64 would overflow), but
+        # the plain sum stays exact-int for integer inputs (x64 only: i32
+        # sums would wrap)
         zf = jnp.where(contrib, data, 0).astype(_float_dtype())
-        s = _seg(zf, gid, cap_out, "sum")
+        if jnp.issubdtype(data.dtype, jnp.integer) and \
+                jax.config.read("jax_enable_x64"):
+            s = _seg(jnp.where(contrib, data, jnp.zeros((), data.dtype)),
+                     gid, cap_out, "sum").astype(_float_dtype())
+        else:
+            s = _seg(zf, gid, cap_out, "sum")
         s2 = _seg(zf * zf, gid, cap_out, "sum")
         nf = cnt.astype(_float_dtype())
         var = (s2 - s * s / jnp.maximum(nf, 1.0)) / jnp.maximum(nf - 1.0, 1.0)
@@ -429,15 +448,20 @@ def window_ordered_core(sgid: jax.Array, tie_data: list[jax.Array],
     if func == "count":
         return run_count, jnp.ones(n, bool)
     if func in ("sum", "avg"):
-        # integer sums accumulate in the integer dtype (exact; f32 on TPU
-        # would lose exactness past 2^24)
-        acc = data.dtype if (func == "sum" and
-                             jnp.issubdtype(data.dtype, jnp.integer)) else fd
+        # integer inputs accumulate in the integer dtype (exact, and avoids
+        # per-row software-f64 scans on TPU; f32 would lose exactness past
+        # 2^24) — avg divides only the final cumulative values. avg keeps
+        # the float path in x32 (i32 cumsums would wrap on big partitions);
+        # sum keeps historical int accumulation in both modes.
+        int_in = jnp.issubdtype(data.dtype, jnp.integer)
+        acc = data.dtype if (int_in and (
+            func == "sum" or jax.config.read("jax_enable_x64"))) else fd
         w = jnp.where(valid, data.astype(acc), jnp.zeros((), acc))
         run_sum = ties_last(_seg_scan(w, new_part, jnp.add))
         if func == "sum":
             return run_sum, out_valid
-        return run_sum / jnp.maximum(run_count, 1).astype(fd), out_valid
+        return (run_sum.astype(fd) /
+                jnp.maximum(run_count, 1).astype(fd)), out_valid
     if func in ("min", "max"):
         # accumulate in the NATIVE dtype: int keys past 2^24 would round
         # in f32 (TPU x32), and f32 round-trips would corrupt exact mins

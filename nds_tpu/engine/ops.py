@@ -246,17 +246,21 @@ def _agg_string_minmax(spec: AggSpec, arg: Column, gid: np.ndarray,
 
 
 def aggregate(table: Table, group_cols: list[Column], aggs: list[AggSpec],
-              agg_args: list[Column | None], rollup: bool = False
+              agg_args: list[Column | None], rollup: bool = False,
+              levels: list[int] | None = None
               ) -> tuple[list[Column], list[Column], Column | None]:
     """Grouped aggregation.
 
     Returns (group_out_cols, agg_out_cols, grouping_id_col or None).
     With rollup=True, emits one block per rollup level, null-filling rolled-up
-    keys, with a Spark-compatible grouping-id bitmask column.
+    keys, with a Spark-compatible grouping-id bitmask column. `levels` (an
+    explicit subset of rollup prefix lengths) supports per-level compile
+    segmentation of big rollups.
     """
-    levels = [len(group_cols)]
-    if rollup:
-        levels = list(range(len(group_cols), -1, -1))
+    if levels is None:
+        levels = [len(group_cols)]
+        if rollup:
+            levels = list(range(len(group_cols), -1, -1))
     blocks: list[tuple[list[Column], list[Column], int]] = []
     for lvl in levels:
         keys = group_cols[:lvl]
@@ -288,9 +292,10 @@ def aggregate(table: Table, group_cols: list[Column], aggs: list[AggSpec],
                        for i in range(lvl, len(group_cols)))
         blocks.append((g_out, a_out, gid_mask))
     if len(blocks) == 1:
-        g_out, a_out, _ = blocks[0]
+        g_out, a_out, mask = blocks[0]
         gidc = Column.from_values(
-            "int", np.zeros(len(g_out[0]) if g_out else len(a_out[0]), np.int64)) \
+            "int", np.full(len(g_out[0]) if g_out else len(a_out[0]), mask,
+                           np.int64)) \
             if rollup else None
         return g_out, a_out, gidc
     g_cat = [concat_columns([b[0][i] for b in blocks])
@@ -385,9 +390,12 @@ def join(left: Table, right: Table, kind: str,
     # The null-aware branch below tests build-side NULLs BEFORE the residual
     # filter, which is wrong when a residual could exclude the NULL-key build
     # rows; the planner guarantees the combination never reaches us
-    # (planner.py _decorrelate raises PlanError for it).
-    assert not (null_aware and residual_eval is not None), \
-        "null-aware anti join with residual is unsupported"
+    # (planner.py _decorrelate raises PlanError for it). A real raise, not an
+    # assert: python -O must not silently return wrong NOT IN results if a
+    # future planner change re-enables the combination.
+    if null_aware and residual_eval is not None:
+        raise NotImplementedError(
+            "null-aware anti join with residual is unsupported")
     if kind == "cross" or not left_keys:
         # keyless joins (pure theta: residual-only condition) are a filtered
         # cross product

@@ -128,6 +128,10 @@ class AggregateNode(PlanNode):
     group_exprs: list[BExpr] = field(default_factory=list)
     aggs: list[AggSpec] = field(default_factory=list)
     rollup: bool = False
+    # compile segmentation may split a rollup into per-level units: an
+    # explicit subset of rollup prefix lengths to emit (None = all levels
+    # when rollup, else just the full grouping)
+    rollup_levels: Optional[list[int]] = None
     # output: group cols, then agg cols, then (if rollup) int col "__grouping_id"
 
 

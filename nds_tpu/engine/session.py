@@ -494,6 +494,8 @@ class Session:
 
         batch_rows = (2 ** 62 if _references_target(stmt.where)
                       else 4_000_000)
+        part_prune = self._partition_prune(stmt.table, stmt.where,
+                                           _references_target)
 
         def keep_filter(t: pa.Table):
             # per-file scoped session: the target table IS this file's rows,
@@ -516,8 +518,88 @@ class Session:
             deleted[ids[hit.columns[0].validity]] = True
             return pa.array(~deleted)
 
-        wt.delete_where(keep_filter, batch_rows=batch_rows)
+        wt.delete_where(keep_filter, batch_rows=batch_rows,
+                        part_prune=part_prune)
         self.warehouse.register_all(self)
+
+    def _partition_prune(self, table: str, where, _references_target):
+        """File-level pruning rule for a DELETE over a partitioned fact
+        table: if some AND-conjunct of the predicate constrains the
+        partition key to a computable value set/range, files of other
+        partition values provably hold no deletable rows (a false/NULL
+        conjunct makes the whole predicate non-TRUE). Returns
+        callable(part_val_str) -> process?, or None when no conjunct is
+        prunable. The DF_* refresh deletes are `key IN (SELECT d_date_sk
+        ...)` — the date-partitioned layout makes them metadata-pruned like
+        the reference's Iceberg deletes (nds/nds_maintenance.py:146-185)."""
+        import numpy as np
+
+        from ..sql import ast_nodes as A
+        from ..warehouse import TABLE_PARTITIONING
+
+        part_col = TABLE_PARTITIONING.get(table)
+        if part_col is None or where is None:
+            return None
+        if _references_target(where):
+            # keep_filter's whole-table-batch invariant: a self-referencing
+            # subquery anywhere in the predicate must see EVERY file, so no
+            # conjunct may prune the read set
+            return None
+
+        def conjuncts(node):
+            if isinstance(node, A.BinOp) and node.op == "and":
+                yield from conjuncts(node.left)
+                yield from conjuncts(node.right)
+            else:
+                yield node
+
+        def is_part_col(e) -> bool:
+            return isinstance(e, A.ColumnRef) and e.name == part_col
+
+        def lit(e):
+            return e.value if isinstance(e, A.Literal) else None
+
+        for c in conjuncts(where):
+            if isinstance(c, A.InSubquery) and not c.negated and \
+                    is_part_col(c.expr):
+                # evaluate ONCE in this session, where the full target
+                # table is still registered (uncorrelated per-file)
+                out = self._run_query_ast(c.query, backend="numpy")
+                col = out.columns[0]
+                vals = np.asarray(col.data)[col.validity] \
+                    if col.validity is not None else np.asarray(col.data)
+                allowed = {str(v) for v in vals.tolist()}
+                # v None = unpartitioned file: could hold anything, process.
+                # The "null" partition never matches IN/=/BETWEEN: prune.
+                return lambda v: v is None or v in allowed
+            if isinstance(c, A.InList) and not c.negated and \
+                    is_part_col(c.expr) and \
+                    all(isinstance(i, A.Literal) for i in c.items):
+                allowed = {str(lit(i)) for i in c.items}
+                return lambda v: v is None or v in allowed
+            if isinstance(c, A.Between) and not c.negated and \
+                    is_part_col(c.expr) and lit(c.low) is not None \
+                    and lit(c.high) is not None:
+                lo, hi = lit(c.low), lit(c.high)
+
+                def in_range(v, lo=lo, hi=hi):
+                    if v is None:
+                        return True
+                    if v == "null":
+                        return False       # NULL key never matches BETWEEN
+                    try:
+                        return lo <= int(v) <= hi
+                    except (TypeError, ValueError):
+                        return True        # unparseable: process the file
+                return in_range
+            if isinstance(c, A.BinOp) and c.op == "=":
+                pair = ((c.left, c.right) if is_part_col(c.left)
+                        else (c.right, c.left) if is_part_col(c.right)
+                        else None)
+                if pair is not None and lit(pair[1]) is not None:
+                    allowed = {str(lit(pair[1]))}
+                    return lambda v: v is None or v in allowed
+        return None
 
     def explain(self, query: str) -> str:
         ast = parse_sql(query)

@@ -39,6 +39,15 @@ TABLE_PARTITIONING = {
 }
 
 
+def _partition_value(path: str):
+    """Partition value from a file path's `<col>=<val>` directory component
+    (None for unpartitioned files; the null partition yields "null")."""
+    d = os.path.basename(os.path.dirname(path))
+    if "=" not in d:
+        return None
+    return d.split("=", 1)[1]
+
+
 class WarehouseTable:
     def __init__(self, root: str, name: str):
         self.dir = os.path.join(root, name)
@@ -141,7 +150,8 @@ class WarehouseTable:
                  else [self._write_file(table)])
         return self._commit(old + files)
 
-    def delete_where(self, keep_filter, batch_rows: int = 4_000_000) -> dict:
+    def delete_where(self, keep_filter, batch_rows: int = 4_000_000,
+                     part_prune=None) -> dict:
         """Rewrite files keeping rows where keep_filter(table) is True.
 
         keep_filter: callable(pa.Table) -> pa.BooleanArray of rows to KEEP.
@@ -152,6 +162,13 @@ class WarehouseTable:
         row-wise, so batch boundaries cannot change results. Files with
         nothing deleted are reused untouched; the rest are rewritten from
         their kept slice.
+
+        part_prune: optional callable(partition-value string or None) ->
+        bool; False promises the file contains no rows to delete, so it is
+        kept untouched WITHOUT being read. The DF_* date-window deletes
+        touch a handful of the date partitions the fact tables are laid out
+        by (reference analog: Iceberg metadata-pruned deletes,
+        nds/nds_maintenance.py:146-185).
         """
         import pyarrow.compute as pc
 
@@ -160,6 +177,16 @@ class WarehouseTable:
             return self._commit([])
 
         new_files: list[str] = []
+        if part_prune is not None:
+            kept_paths = []
+            for path in paths:
+                if part_prune(_partition_value(path)):
+                    kept_paths.append(path)
+                else:
+                    new_files.append(os.path.relpath(path, self.dir))
+            paths = kept_paths
+            if not paths:
+                return self._commit(new_files)
 
         def flush(batch_paths, batch_tables):
             whole = batch_tables[0] if len(batch_tables) == 1 else \

@@ -149,3 +149,35 @@ def test_scan_budget_evicts_lru():
         sql = f"SELECT sum(v) FROM {name} WHERE k > 10"
         assert s.sql(sql, backend="jax").to_pylist() == sums[name]
         assert s.last_exec_stats.get("mode") in ("compiled", "compile+run")
+
+
+ROLLUP_SQL = ("SELECT g, t.k, count(*) c, sum(v) s FROM t JOIN d "
+              "ON t.k = d.k WHERE v > 2 GROUP BY ROLLUP(g, t.k)")
+
+
+def test_rollup_splits_into_per_level_units(seg_session):
+    """A big rollup over a CTE-less plan segments at grouping-set
+    boundaries (the q67 compile-pathology fix): child materializes once,
+    each level compiles separately, and the union of levels matches the
+    in-program rollup exactly."""
+    s = seg_session
+    expected = _rows(s.sql(ROLLUP_SQL, backend="numpy"))
+    for i in range(3):
+        assert _rows(s.sql(ROLLUP_SQL, backend="jax")) == expected, f"run {i}"
+        assert s.last_fallbacks == []
+    st = s.last_exec_stats
+    assert st["mode"] == "compiled"
+    # 1 child unit + 3 level units (g,k / g / ()) + root
+    assert st["segments"] == 4
+    assert st["segments_run"] == 0
+
+
+def test_rollup_split_grouping_id(seg_session):
+    """GROUPING() semantics survive the split: per-level units emit the
+    right grouping-id bitmask (regression for the single-level path)."""
+    s = seg_session
+    sql = ("SELECT g, t.k, GROUPING(g), GROUPING(t.k), sum(v) FROM t "
+           "JOIN d ON t.k = d.k GROUP BY ROLLUP(g, t.k)")
+    expected = _rows(s.sql(sql, backend="numpy"))
+    for _ in range(2):
+        assert _rows(s.sql(sql, backend="jax")) == expected

@@ -33,8 +33,8 @@ from ..executor import Executor as HostExecutor
 from ..plan import (
     AggregateNode, AggSpec, BExpr, DistinctNode, FilterNode, JoinNode,
     LimitNode, MaterializedNode, PlanNode, ProjectNode, ScanNode, SetOpNode,
-    SortNode, VirtualScanNode, WindowFunc, WindowNode, iter_plan_nodes,
-    replace_plan_nodes,
+    SortNode, VirtualScanNode, WindowFunc, WindowNode, deparameterize_plan,
+    iter_plan_nodes, parameterize_plan, replace_plan_nodes,
 )
 from . import jexprs, kernels
 from .device import (DCol, DTable, bucket, free_dtable, phys_dtype, rank_key,
@@ -80,23 +80,31 @@ def _verify_schedule(decisions: list, checks_host: list) -> None:
 
 
 class CompiledQuery:
-    """One whole-plan XLA program built from a recorded capacity schedule."""
+    """One whole-plan XLA program built from a recorded capacity schedule.
+
+    Scan tables enter as a TUPLE in first-touch order and hoisted stream
+    literals as a parameter vector: the traced program is therefore
+    byte-identical across streams/seeds of one template (same structure,
+    same capacities), and the persistent XLA cache serves every stream
+    after the first compile."""
 
     def __init__(self, plan: PlanNode, decisions: list, scan_keys: tuple,
-                 mesh=None):
+                 mesh=None, param_dtypes: tuple = ()):
         self.plan = plan
         self.decisions = decisions
         self.scan_keys = scan_keys
         self.mesh = mesh
+        self.param_dtypes = param_dtypes
         self._fn = None
 
-    def _trace(self, scans: dict):
+    def _trace(self, scan_tuple: tuple, params: tuple):
+        scans = dict(zip(self.scan_keys, scan_tuple))
         rec = _Recorder("replay", self.decisions)
         # the mesh MUST match the recording executor's: static branches
         # (compaction skip, shard-local aggregation) key on it, and a
         # mesh-less replay would consume a mesh-recorded schedule
         ex = JaxExecutor(_no_load, recorder=rec, scan_tables=scans,
-                         mesh=self.mesh)
+                         mesh=self.mesh, params=params)
         out = ex.execute(self.plan)
         if rec.idx != len(rec.decisions):
             raise NotJittable("decision schedule length drift")
@@ -104,7 +112,14 @@ class CompiledQuery:
             raise NotJittable(f"fallback under trace: {ex.fallback_nodes}")
         return out, rec.checks
 
-    def run(self, scans: dict, stats: Optional[dict] = None,
+    def _args(self, scans: dict, values: tuple) -> tuple:
+        scan_tuple = tuple(scans[k] for k in self.scan_keys)
+        params = tuple(jnp.asarray(v, dtype=phys_dtype(d))
+                       for v, d in zip(values, self.param_dtypes))
+        return scan_tuple, params
+
+    def run(self, scans: dict, values: tuple = (),
+            stats: Optional[dict] = None,
             keep_device: bool = False) -> DTable:
         import time as _time
 
@@ -112,7 +127,7 @@ class CompiledQuery:
         if first:
             self._fn = jax.jit(self._trace)
         t1 = _time.perf_counter()
-        out, checks = self._fn(scans)
+        out, checks = self._fn(*self._args(scans, values))
         # ONE device_get for result + checks: tunneled platforms charge a
         # fixed RTT per transfer, so piecemeal np.asarray would dominate.
         # keep_device (segment outputs feeding downstream programs): only
@@ -151,8 +166,12 @@ class JaxExecutor:
                  segment_plan_nodes: int = 18,
                  segment_min_cte_nodes: int = 8,
                  segment_cache_entries: int = 16,
-                 scan_budget_bytes: int = 10 << 30):
+                 scan_budget_bytes: int = 10 << 30,
+                 params: Optional[tuple] = None):
         self._load_table = load_table
+        # hoisted literal values for the in-flight execution: python scalars
+        # under eager record, traced 0-d arrays under compiled replay
+        self._params = params
         self._memo: dict[int, DTable] = {}
         self._scan_cache: dict[str, DTable] = scan_tables if scan_tables \
             is not None else {}           # accelerator-resident tables
@@ -161,7 +180,7 @@ class JaxExecutor:
         self._replay = recorder is not None and recorder.mode == "replay"
         self._jit_plans = jit_plans
         self._plans: dict = {}           # query key -> plan/schedule entry
-        self._touched_scans: set[str] = set()
+        self._touched_scans: dict[str, None] = {}   # ordered set (first touch)
         self._scan_meta: dict[str, tuple] = {}   # key -> (table, cols, names)
         self.fallback_nodes: list[str] = []   # observability: who fell back
         # SPMD execution: with a mesh, fact-sized scans upload row-sharded
@@ -420,13 +439,14 @@ class JaxExecutor:
                         self._plans.pop(key, None)
                     self.last_stats.update(mode="eager",
                                            transient=f"{e}"[:200])
-                    return self._eager(ent["plan"])
+                    return self._eager_ent(ent)
             elif ent["nojit"]:
                 self.last_stats["mode"] = "eager"
-                return self._eager(ent["plan"])
+                return self._eager_ent(ent)
             else:                                      # second sighting
                 cq = CompiledQuery(ent["plan"], ent["decisions"],
-                                   ent["scan_keys"], mesh=self._mesh)
+                                   ent["scan_keys"], mesh=self._mesh,
+                                   param_dtypes=ent.get("param_dtypes", ()))
                 try:
                     out = self._run_compiled(cq, ent, keep_device)
                     ent["cq"] = cq
@@ -437,7 +457,7 @@ class JaxExecutor:
                     ent["nojit_reason"] = f"{type(e).__name__}: {e}"
                     self.last_stats["mode"] = "eager"
                     self.last_stats["nojit_reason"] = ent["nojit_reason"]
-                    return self._eager(ent["plan"])
+                    return self._eager_ent(ent)
                 except ReplayMismatch:
                     self._plans.pop(key, None)
                     ent = None
@@ -449,15 +469,20 @@ class JaxExecutor:
                         self._plans.pop(key, None)
                     self.last_stats.update(mode="eager",
                                            transient=f"{e}"[:200])
-                    return self._eager(ent["plan"])
+                    return self._eager_ent(ent)
         # first sighting (or invalidated): eager run, recording the schedule
         plan = plan_factory()
+        if key is not None and self._jit_plans:
+            pplan, pvalues, pdtypes = parameterize_plan(plan)
+        else:       # uncached one-shot: skip the rewrite, nothing reuses it
+            pplan, pvalues, pdtypes = plan, [], []
         self.last_stats["mode"] = "record"
-        out, decisions, scan_keys = self.record_plan(plan)
+        out, decisions, scan_keys = self.record_plan(pplan, tuple(pvalues))
         if key is not None and self._jit_plans:
             self._plans[key] = {
-                "plan": plan, "decisions": decisions,
+                "plan": pplan, "decisions": decisions,
                 "scan_keys": scan_keys,
+                "params": tuple(pvalues), "param_dtypes": tuple(pdtypes),
                 "cq": None, "nojit": len(self.fallback_nodes) > fb0}
         return out
 
@@ -471,21 +496,28 @@ class JaxExecutor:
             if ent is not None and ent.get("cq") is not None \
                     and ent["cq"]._fn is not None:
                 cq = ent["cq"]
-                lowered = cq._fn.lower(self._scans_for(ent))
+                lowered = cq._fn.lower(*cq._args(self._scans_for(ent),
+                                                 ent.get("params", ())))
                 return lowered.compile().as_text()
         return None
 
-    def record_plan(self, plan: PlanNode):
+    def record_plan(self, plan: PlanNode, params: tuple = ()):
         """Eager run that records the capacity schedule; returns
-        (result, decisions, scan_keys)."""
+        (result, decisions, scan_keys). scan_keys keep FIRST-TOUCH order
+        (plan-traversal order, stream-invariant) — sorting would let
+        stream-specific segment fingerprints permute the compiled
+        program's argument order and break cross-stream HLO identity."""
         rec = _Recorder("record")
         self._rec = rec
-        self._touched_scans = set()
+        self._touched_scans = {}
+        old_params = self._params
+        self._params = params
         try:
             out = self._eager(plan)
         finally:
             self._rec = None
-        return out, rec.decisions, tuple(sorted(self._touched_scans))
+            self._params = old_params
+        return out, rec.decisions, tuple(self._touched_scans)
 
     def _load_columns(self, table: str, columns) -> Table:
         from ..executor import load_columns
@@ -495,12 +527,22 @@ class JaxExecutor:
                       keep_device: bool = False) -> DTable:
         """Run a compiled plan, retrying once on transient runtime errors
         (the remote compile/execute service can drop a connection)."""
+        values = ent.get("params", ())
         try:
-            return cq.run(self._scans_for(ent), stats=self.last_stats,
+            return cq.run(self._scans_for(ent), values, stats=self.last_stats,
                           keep_device=keep_device)
         except jax.errors.JaxRuntimeError:
-            return cq.run(self._scans_for(ent), stats=self.last_stats,
+            return cq.run(self._scans_for(ent), values, stats=self.last_stats,
                           keep_device=keep_device)
+
+    def _eager_ent(self, ent) -> DTable:
+        """Eager-run a cached entry's (parameterized) plan with its values."""
+        old = self._params
+        self._params = ent.get("params", ())
+        try:
+            return self._eager(ent["plan"])
+        finally:
+            self._params = old
 
     def _eager(self, plan: PlanNode) -> DTable:
         self._memo = {}
@@ -653,7 +695,18 @@ class JaxExecutor:
 
     # -- helpers -------------------------------------------------------------
     def _eval(self, expr: BExpr, table: DTable) -> DCol:
-        return jexprs.evaluate(expr, table, subquery_eval=self._scalar)
+        return jexprs.evaluate(expr, table, subquery_eval=self._ectx())
+
+    def _ectx(self) -> "jexprs.EvalCtx":
+        return jexprs.EvalCtx(subquery=self._scalar, param=self._param)
+
+    def _param(self, expr, n: int) -> DCol:
+        if self._params is None:
+            raise NotJittable("parameter slot without bound values")
+        v = self._params[expr.index]
+        pd = phys_dtype(expr.dtype)
+        data = jnp.broadcast_to(jnp.asarray(v, dtype=pd), (n,))
+        return DCol(expr.dtype, data, jnp.ones(n, bool))
 
     def _dense_rank(self, key_data: list, key_valid: list,
                     alive) -> tuple:
@@ -715,6 +768,9 @@ class JaxExecutor:
                     table=t, label=f"device:{f}",
                     out_names=list(sub.out_names), out_dtypes=list(sub.out_dtypes))
         host_node = dataclasses.replace(node, **repl) if repl else node
+        if self._params is not None:
+            # the numpy expression engine evaluates literals, not slots
+            host_node = deparameterize_plan(host_node, list(self._params))
         # expression-embedded subplans can still reference segmented CTEs:
         # the host executor has no segment cache, so materialize them
         vmap = {}
@@ -830,7 +886,7 @@ class JaxExecutor:
     def _run_virtual(self, node: VirtualScanNode) -> DTable:
         """A segmented-CTE output: resolved against the segment cache (the
         orchestrator in run_query materializes segments before consumers)."""
-        self._touched_scans.add(node.key)
+        self._touched_scans.setdefault(node.key)
         cache = self._scan_cache if self._replay else self._scan_cache_rec
         t = cache.get(node.key)
         if t is None:
@@ -858,7 +914,7 @@ class JaxExecutor:
             cols = [t.columns[index[c]] for c in node.columns]
             cache[cache_key] = to_device(Table(list(node.out_names), cols),
                                          device=self._eager_device)
-        self._touched_scans.add(cache_key)
+        self._touched_scans.setdefault(cache_key)
         self._scan_meta[cache_key] = (node.table, list(node.columns),
                                       list(node.out_names))
         cached = cache[cache_key]
@@ -1466,7 +1522,7 @@ class JaxExecutor:
         combined = DTable(names, list(left.cols) + rcols, left.alive)
         if node.residual is not None:
             mask = jexprs.evaluate(node.residual, combined,
-                                   subquery_eval=self._scalar)
+                                   subquery_eval=self._ectx())
             matched = matched & mask.data.astype(bool) & mask.valid
 
         if kind == "semi":
@@ -1505,7 +1561,7 @@ class JaxExecutor:
             else [f"__c{i}" for i in range(len(cols))]
         out = DTable(names, cols, alive_out)
         if residual is not None:
-            mask = jexprs.evaluate(residual, out, subquery_eval=self._scalar)
+            mask = jexprs.evaluate(residual, out, subquery_eval=self._ectx())
             out = DTable(out.names, out.cols,
                          kernels.filter_alive(out.alive, mask.data, mask.valid))
         return out, left_idx, right_rows

@@ -19,10 +19,21 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..column import dec_scale, is_dec
-from ..plan import BCall, BCol, BExpr, BLit, BScalarSubquery
+from ..plan import BCall, BCol, BExpr, BLit, BParam, BScalarSubquery
 from .device import DCol, DTable, phys_dtype, string_rank_lut
 
 SubqueryEval = Callable[[object], object]
+
+
+class EvalCtx:
+    """Evaluation callbacks bundle, threaded opaquely through handlers in
+    the `subquery_eval` position: `subquery` resolves BScalarSubquery
+    plans, `param` resolves BParam slots (hoisted stream literals)."""
+    __slots__ = ("subquery", "param")
+
+    def __init__(self, subquery=None, param=None):
+        self.subquery = subquery
+        self.param = param
 
 
 def _float_dtype():
@@ -44,10 +55,18 @@ def evaluate(expr: BExpr, table: DTable,
         return table.cols[expr.index]
     if isinstance(expr, BLit):
         return constant(expr.dtype, expr.value, n)
+    if isinstance(expr, BParam):
+        param = subquery_eval.param \
+            if isinstance(subquery_eval, EvalCtx) else None
+        if param is None:
+            raise RuntimeError("parameter slot encountered without values")
+        return param(expr, n)
     if isinstance(expr, BScalarSubquery):
-        if subquery_eval is None:
+        sq = subquery_eval.subquery \
+            if isinstance(subquery_eval, EvalCtx) else subquery_eval
+        if sq is None:
             raise RuntimeError("scalar subquery encountered without evaluator")
-        value, valid = subquery_eval(expr.plan)
+        value, valid = sq(expr.plan)
         return constant(expr.dtype, value, n, valid)
     if isinstance(expr, BCall):
         handler = _HANDLERS.get(expr.op)
@@ -220,6 +239,14 @@ def _isnotnull(expr: BCall, table: DTable, sq) -> DCol:
 def _in_list(expr: BCall, table: DTable, sq) -> DCol:
     a = evaluate(expr.args[0], table, sq)
     values = expr.extra
+    if any(isinstance(v, BParam) for v in values):
+        # hoisted int/date items: resolve to (possibly traced) scalars so
+        # the membership test stays stream-invariant in the program
+        param = sq.param if isinstance(sq, EvalCtx) else None
+        if param is None:
+            raise NotImplementedError("in_list params without values")
+        values = [param(v, 1).data[0] if isinstance(v, BParam) else v
+                  for v in values]
     has_null = any(v is None for v in values)
     if a.dtype == "str":
         d = _dict(a)

@@ -39,6 +39,19 @@ class BCall(BExpr):
 
 
 @dataclass
+class BParam(BExpr):
+    """A hoisted literal: slot `index` of the execution's parameter vector.
+
+    Stream-generated statements differ only in template parameter literals
+    (reference dsqgen substitution, nds/nds_gen_query_stream.py:42-89);
+    hoisting them out of the plan makes the compiled XLA program identical
+    across streams/seeds, so the persistent compile cache serves every
+    stream after the first (the Spark analog: re-planning is milliseconds,
+    nds/nds_power.py:124-134)."""
+    index: int
+
+
+@dataclass
 class BScalarSubquery(BExpr):
     plan: "PlanNode"
 
@@ -216,6 +229,162 @@ def iter_plan_nodes(root: PlanNode):
                 stack.append(getattr(x, f.name))
         elif isinstance(x, (list, tuple)):
             stack.extend(x)
+
+
+# ops whose handlers consume literal arguments as traced device scalars —
+# a literal under any OTHER op (substr positions, LIKE patterns, cast
+# payloads, string work) may be read on the host at trace time and must
+# stay baked into the program
+_PARAM_SAFE_OPS = frozenset({
+    "add", "sub", "mul", "div", "mod", "neg", "eq", "ne", "lt", "le", "gt",
+    "ge", "and", "or", "not", "case", "coalesce", "nullif", "in_list", "abs",
+})
+
+
+def _param_hoistable(lit: "BLit") -> bool:
+    return lit.value is not None and (
+        lit.dtype in ("int", "float", "date", "bool")
+        or lit.dtype.startswith("dec"))
+
+
+def parameterize_plan(root: PlanNode) -> tuple[PlanNode, list, list]:
+    """Hoist numeric/date/decimal/bool literals into parameter slots.
+
+    Returns (rewritten plan, values, dtypes): every hoisted BLit becomes a
+    BParam(index) and its value/dtype land at that index. Only
+    literals in _PARAM_SAFE_OPS argument positions hoist; traversal order
+    is deterministic, so two stream-instantiations of one template yield
+    THE SAME rewritten plan with different `values` — and therefore the
+    same compiled program (see BParam). Node sharing (CTE DAGs) is
+    preserved."""
+    import dataclasses as _dc
+
+    values: list = []
+    dtypes: list = []
+    memo: dict[int, object] = {}
+
+    def rw_expr(e, safe_parent: bool):
+        if isinstance(e, BLit):
+            if safe_parent and _param_hoistable(e):
+                values.append(e.value)
+                dtypes.append(e.dtype)
+                return BParam(e.dtype, index=len(values) - 1)
+            return e
+        if isinstance(e, BCall):
+            safe = e.op in _PARAM_SAFE_OPS
+            args = [rw_expr(a, safe) for a in e.args]
+            extra = e.extra
+            # IN-list values ride in `extra` as a host list; int/date items
+            # hoist as params (the device handler resolves BParam entries)
+            if e.op == "in_list" and isinstance(extra, list) and \
+                    args and args[0].dtype in ("int", "date"):
+                new_extra = []
+                for v in extra:
+                    # only EXACT ints hoist against an int/date probe: a
+                    # non-integral item (1.5) matches nothing under float
+                    # promotion, but an int-dtype param cast would truncate
+                    # it into a spurious match
+                    if isinstance(v, bool) or not isinstance(v, int):
+                        new_extra.append(v)
+                    else:
+                        values.append(v)
+                        dtypes.append(args[0].dtype)
+                        new_extra.append(BParam(args[0].dtype,
+                                                index=len(values) - 1))
+                if any(isinstance(v, BParam) for v in new_extra):
+                    extra = new_extra
+            if extra is e.extra and all(
+                    a is b for a, b in zip(args, e.args)):
+                return e
+            return _dc.replace(e, args=args, extra=extra)
+        if isinstance(e, BScalarSubquery):
+            p = rw_plan(e.plan)
+            return e if p is e.plan else _dc.replace(e, plan=p)
+        return e
+
+    def rw_other(x):
+        if isinstance(x, BExpr):
+            return rw_expr(x, False)
+        if isinstance(x, list):
+            out = [rw_other(v) for v in x]
+            return out if any(a is not b for a, b in zip(out, x)) else x
+        if isinstance(x, tuple):
+            out = tuple(rw_other(v) for v in x)
+            return out if any(a is not b for a, b in zip(out, x)) else x
+        if _dc.is_dataclass(x) and not isinstance(x, type) \
+                and not isinstance(x, PlanNode):
+            changes = {}
+            for f in _dc.fields(x):
+                v = getattr(x, f.name)
+                nv = rw_other(v)
+                if nv is not v:
+                    changes[f.name] = nv
+            return _dc.replace(x, **changes) if changes else x
+        return x
+
+    def rw_plan(node):
+        if id(node) in memo:
+            return memo[id(node)]
+        if isinstance(node, MaterializedNode):
+            memo[id(node)] = node
+            return node
+        changes = {}
+        for f in _dc.fields(node):
+            v = getattr(node, f.name)
+            nv = rw_plan(v) if isinstance(v, PlanNode) else rw_other(v)
+            if nv is not v:
+                changes[f.name] = nv
+        out = _dc.replace(node, **changes) if changes else node
+        memo[id(node)] = out
+        return out
+
+    return rw_plan(root), values, dtypes
+
+
+def deparameterize_plan(root: PlanNode, values: list) -> PlanNode:
+    """Substitute parameter values back as literals (host-fallback plans:
+    the numpy expression engine evaluates literals, not parameter slots)."""
+    import dataclasses as _dc
+
+    memo: dict[int, object] = {}
+
+    def rw(x):
+        if isinstance(x, BParam):
+            return BLit(x.dtype, values[x.index])
+        if isinstance(x, BCall):
+            args = rw(x.args)
+            extra = x.extra
+            if isinstance(extra, list) and \
+                    any(isinstance(v, BParam) for v in extra):
+                # in_list extras hold RAW python values, not BLit nodes
+                extra = [values[v.index] if isinstance(v, BParam) else v
+                         for v in extra]
+            if args is x.args and extra is x.extra:
+                return x
+            return _dc.replace(x, args=args, extra=extra)
+        if isinstance(x, MaterializedNode):
+            return x
+        if _dc.is_dataclass(x) and not isinstance(x, type):
+            if id(x) in memo:
+                return memo[id(x)]
+            changes = {}
+            for f in _dc.fields(x):
+                v = getattr(x, f.name)
+                nv = rw(v)
+                if nv is not v:
+                    changes[f.name] = nv
+            out = _dc.replace(x, **changes) if changes else x
+            memo[id(x)] = out
+            return out
+        if isinstance(x, list):
+            out = [rw(v) for v in x]
+            return out if any(a is not b for a, b in zip(out, x)) else x
+        if isinstance(x, tuple):
+            out = tuple(rw(v) for v in x)
+            return out if any(a is not b for a, b in zip(out, x)) else x
+        return x
+
+    return rw(root)
 
 
 def replace_plan_nodes(root, mapping: dict):

@@ -338,12 +338,26 @@ class Planner:
     def _plan_from_where(self, sel: A.Select, outer, ctes):
         if sel.from_ is None:
             raise PlanError("SELECT without FROM is not supported")
-        units = self._flatten_from(sel.from_, ctes, outer)
+        # explicit INNER JOIN chains flatten into the same unit/edge machinery
+        # as comma joins (inner joins commute): ON conjuncts classify exactly
+        # like WHERE conjuncts, giving filter pushdown and size-ordered join
+        # placement to JOIN-syntax templates (reference query72's
+        # cs JOIN inventory ON item would otherwise expand row-count-first in
+        # syntax order). Top-level LEFT joins peel into an ordered tail
+        # applied after the greedy join.
+        tail_specs: list = []
+        root = self._peel_outer_tail(sel.from_, tail_specs)
+        on_conjs: list = []
+        units = self._flatten_from(root, ctes, outer, on_conjs)
+        tail_units = [(kind, self._plan_relation(rnode, ctes, outer), on_ast)
+                      for kind, rnode, on_ast in tail_specs]
+        n_inner = len(units)
+        all_units = units + [tu for _, tu, _ in tail_units]
 
         # full scope in declaration order
         scope_entries, offset = [], 0
         unit_offsets = []
-        for u in units:
+        for u in all_units:
             unit_offsets.append(offset)
             for e in u.entries:
                 scope_entries.append(replace(e, index=offset + e.index))
@@ -351,23 +365,30 @@ class Planner:
         scope = Scope(scope_entries, parent=outer)
 
         conjuncts = _split_and(sel.where) if sel.where is not None else []
+        conjuncts = conjuncts + on_conjs
         conjuncts = conjuncts + _or_implied_conjuncts(conjuncts)
         edges, residuals, subq_conjs = [], [], []
         for c in conjuncts:
             if _has_subquery(c):
                 subq_conjs.append(c)
                 continue
-            refs = self._referenced_units(c, units, scope, unit_offsets)
+            refs = self._referenced_units(c, all_units, scope, unit_offsets)
             if refs is None:
                 residuals.append(c)  # references outer scope: bind later
+            elif refs and max(refs) >= n_inner:
+                # touches a LEFT-join tail unit: filtering inside/below the
+                # outer join would change null-extension semantics
+                residuals.append(c)
             elif len(refs) <= 1:
                 if refs:
                     units[next(iter(refs))].filters.append(c)
                 else:
                     residuals.append(c)  # constant predicate
             elif (len(refs) == 2 and isinstance(c, A.BinOp) and c.op == "="):
-                lrefs = self._referenced_units(c.left, units, scope, unit_offsets)
-                rrefs = self._referenced_units(c.right, units, scope, unit_offsets)
+                lrefs = self._referenced_units(c.left, all_units, scope,
+                                               unit_offsets)
+                rrefs = self._referenced_units(c.right, all_units, scope,
+                                               unit_offsets)
                 if lrefs is not None and rrefs is not None and \
                         len(lrefs) == 1 and len(rrefs) == 1 and lrefs != rrefs:
                     la, rb = next(iter(lrefs)), next(iter(rrefs))
@@ -391,9 +412,33 @@ class Planner:
 
         rel, col_map = self._join_units(units, edges, ctes, outer)
 
+        # LEFT-join tail, in syntax order, over the greedy-joined group
+        width = sum(len(u.entries) for u in units)
+        for t_idx, (kind, tu, on_ast) in enumerate(tail_units):
+            joined_entries = self._joined_entries(all_units, col_map)
+            nleft = width
+            combined = joined_entries + [
+                replace(e, index=nleft + e.index) for e in tu.entries]
+            scope2 = Scope(combined, parent=outer)
+            binder2 = _Binder(self, scope2, ctes, outer=outer)
+            lkeys, rkeys, res_parts = [], [], []
+            for c in _split_and(on_ast):
+                pair = self._equi_pair(c, scope2, nleft, binder2)
+                if pair is not None:
+                    lkeys.append(pair[0])
+                    rkeys.append(pair[1])
+                else:
+                    res_parts.append(binder2.bind(c))
+            rel = P.JoinNode(
+                rel, tu.plan, kind, lkeys, rkeys, _and_all(res_parts),
+                out_names=rel.out_names + tu.plan.out_names,
+                out_dtypes=rel.out_dtypes + tu.plan.out_dtypes)
+            col_map[n_inner + t_idx] = width
+            width += len(tu.entries)
+
         # permutation back to declaration order
         perm = [None] * len(scope_entries)
-        for ui, u in enumerate(units):
+        for ui, u in enumerate(all_units):
             for e in u.entries:
                 perm[unit_offsets[ui] + e.index] = col_map[ui] + e.index
         exprs = [P.BCol(scope_entries[i].dtype, perm[i], scope_entries[i].name)
@@ -413,11 +458,30 @@ class Planner:
             rel = self._apply_subquery_conjunct(rel, scope, c, ctes, outer)
         return rel, scope, deferred
 
-    def _flatten_from(self, node, ctes, outer) -> list[_Unit]:
-        """Comma/cross joins become separate units; explicit joins one unit."""
+    def _peel_outer_tail(self, node, tail: list):
+        """Peel top-level LEFT joins into an ordered tail (deepest first);
+        returns the inner root. `(G JOIN… ) LEFT JOIN p ON … LEFT JOIN r`
+        becomes greedy(G) + tail [p, r] — outer joins are order barriers,
+        inner groups beneath them are not."""
+        if isinstance(node, A.Join) and node.kind == "left" \
+                and node.on is not None:
+            inner = self._peel_outer_tail(node.left, tail)
+            tail.append((node.kind, node.right, node.on))
+            return inner
+        return node
+
+    def _flatten_from(self, node, ctes, outer, on_acc: list) -> list[_Unit]:
+        """Comma/cross joins AND explicit inner joins become separate units
+        (their ON conjuncts accumulate into on_acc for edge classification);
+        everything else is one unit."""
         if isinstance(node, A.Join) and node.kind == "cross" and node.on is None:
-            return self._flatten_from(node.left, ctes, outer) + \
-                self._flatten_from(node.right, ctes, outer)
+            return self._flatten_from(node.left, ctes, outer, on_acc) + \
+                self._flatten_from(node.right, ctes, outer, on_acc)
+        if isinstance(node, A.Join) and node.kind == "inner" \
+                and node.on is not None and not _has_subquery(node.on):
+            on_acc.extend(_split_and(node.on))
+            return self._flatten_from(node.left, ctes, outer, on_acc) + \
+                self._flatten_from(node.right, ctes, outer, on_acc)
         return [self._plan_relation(node, ctes, outer)]
 
     def _plan_relation(self, node, ctes, outer) -> _Unit:
@@ -553,11 +617,17 @@ class Planner:
             remaining.discard(pick)
         return current_plan, col_map
 
-    def _bind_in_joined(self, expr, units, col_map, ctes, outer):
+    @staticmethod
+    def _joined_entries(units, col_map):
+        """Scope entries of the joined-so-far relation, offset per col_map."""
         entries = []
         for ui, off in col_map.items():
             for e in units[ui].entries:
                 entries.append(replace(e, index=off + e.index))
+        return entries
+
+    def _bind_in_joined(self, expr, units, col_map, ctes, outer):
+        entries = self._joined_entries(units, col_map)
         return _Binder(self, Scope(entries, parent=outer), ctes,
                        outer=outer).bind(expr)
 

@@ -29,8 +29,11 @@ class EngineConfig:
     # device mesh axis for data-parallel table sharding
     mesh_shape: tuple[int, ...] = ()
     mesh_axis_names: tuple[str, ...] = ("shards",)
-    # rows per morsel when streaming host->device
-    chunk_rows: int = 1 << 20
+    # rows per morsel when streaming host->device. Sized to amortize the
+    # tunnel RTT per dispatch (measured ~6 s/morsel at 1M rows, RTT-bound:
+    # an SF100 scan is hundreds of morsels) while keeping the record pass
+    # and device working set bounded.
+    chunk_rows: int = 1 << 22
     # out-of-core execution: stream aggregates over one large scan in
     # chunk_rows morsels (bounded peak memory; SURVEY.md §5 long-context
     # analog). Eligible plans only; others run in-core. Default ON with a

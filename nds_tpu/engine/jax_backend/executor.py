@@ -37,8 +37,9 @@ from ..plan import (
     iter_plan_nodes, parameterize_plan, replace_plan_nodes,
 )
 from . import jexprs, kernels
-from .device import (DCol, DTable, bucket, free_dtable, phys_dtype, rank_key,
-                     string_rank_lut, to_device, to_host)
+from .device import (DCol, DTable, PackedTable, bucket, free_dtable,
+                     phys_dtype, rank_key, string_rank_lut, to_device,
+                     to_host, unpack_table)
 
 _I32 = jnp.int32
 
@@ -552,12 +553,10 @@ class JaxExecutor:
         return self.execute(plan)
 
     @staticmethod
-    def _dtable_bytes(t: DTable) -> int:
-        total = int(t.alive.size)
-        for c in t.cols:
-            for leaf in jax.tree_util.tree_leaves(c):
-                total += int(leaf.size) * leaf.dtype.itemsize
-        return total
+    def _dtable_bytes(t) -> int:
+        """Device bytes of a cached entry (DTable or PackedTable)."""
+        return sum(int(leaf.size) * leaf.dtype.itemsize
+                   for leaf in jax.tree_util.tree_leaves(t))
 
     def _account_resident(self, key: str, t: DTable,
                           pinned: Optional[set] = None) -> None:
@@ -918,6 +917,10 @@ class JaxExecutor:
         self._scan_meta[cache_key] = (node.table, list(node.columns),
                                       list(node.out_names))
         cached = cache[cache_key]
+        if isinstance(cached, PackedTable):
+            # packed morsel upload: column slicing/bitcasts fuse into the
+            # compiled program (see PackedTable)
+            cached = unpack_table(cached)
         return DTable(list(node.out_names), cached.cols, cached.alive)
 
     # -- sort / distinct -----------------------------------------------------

@@ -298,7 +298,8 @@ class Session:
         not streamable."""
         from . import streaming
         from .jax_backend import JaxExecutor, to_host
-        from .jax_backend.device import bucket, free_dtable, to_device
+        from .jax_backend.device import (bucket, free_dtable,
+                                        pack_table, to_device)
         from .jax_backend.executor import CompiledQuery, ReplayMismatch
 
         if self._stream_cache_gen != self._generation:
@@ -361,8 +362,12 @@ class Session:
             cq, ent, mkey = sent["cq"], sent["ent"], sent["mkey"]
             cols = mkey.split("//", 1)[1].split(",")
             free_dtable(jexec._scan_cache.get(mkey))
-            jexec._scan_cache[mkey] = to_device(morsel.select(cols),
-                                                capacity=cap)
+            packed = pack_table(morsel.select(cols), capacity=cap)
+            # packed = ~2 transfers per morsel instead of 2*ncols (tunneled
+            # links charge a fixed RTT per transfer); falls back when
+            # unpackable (x32, bool/string payloads)
+            jexec._scan_cache[mkey] = packed if packed is not None else \
+                to_device(morsel.select(cols), capacity=cap)
             try:
                 out = cq.run(jexec._scans_for(ent))
             except ReplayMismatch:

@@ -27,6 +27,7 @@ from typing import Callable, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from ..column import Table, dec_scale, is_dec
 from ..executor import Executor as HostExecutor
@@ -90,22 +91,26 @@ class CompiledQuery:
     after the first compile."""
 
     def __init__(self, plan: PlanNode, decisions: list, scan_keys: tuple,
-                 mesh=None, param_dtypes: tuple = ()):
+                 mesh=None, param_dtypes: tuple = (),
+                 shard_min_rows: int = 1 << 18):
         self.plan = plan
         self.decisions = decisions
         self.scan_keys = scan_keys
         self.mesh = mesh
         self.param_dtypes = param_dtypes
+        self.shard_min_rows = shard_min_rows
         self._fn = None
 
     def _trace(self, scan_tuple: tuple, params: tuple):
         scans = dict(zip(self.scan_keys, scan_tuple))
         rec = _Recorder("replay", self.decisions)
-        # the mesh MUST match the recording executor's: static branches
-        # (compaction skip, shard-local aggregation) key on it, and a
-        # mesh-less replay would consume a mesh-recorded schedule
+        # the mesh AND size thresholds MUST match the recording executor's:
+        # static branches (compaction skip, shard-local aggregation, the
+        # shuffle-join gate) key on them, and a mismatched replay would
+        # consume a differently-shaped schedule
         ex = JaxExecutor(_no_load, recorder=rec, scan_tables=scans,
-                         mesh=self.mesh, params=params)
+                         mesh=self.mesh, params=params,
+                         shard_min_rows=self.shard_min_rows)
         out = ex.execute(self.plan)
         if rec.idx != len(rec.decisions):
             raise NotJittable("decision schedule length drift")
@@ -447,7 +452,8 @@ class JaxExecutor:
             else:                                      # second sighting
                 cq = CompiledQuery(ent["plan"], ent["decisions"],
                                    ent["scan_keys"], mesh=self._mesh,
-                                   param_dtypes=ent.get("param_dtypes", ()))
+                                   param_dtypes=ent.get("param_dtypes", ()),
+                                   shard_min_rows=self._shard_min_rows)
                 try:
                     out = self._run_compiled(cq, ent, keep_device)
                     ent["cq"] = cq
@@ -719,13 +725,8 @@ class JaxExecutor:
         if (self._mesh is None and key_data and n >= (1 << 13)
                 and all(jnp.issubdtype(d.dtype, jnp.integer)
                         for d in key_data)):
-            limit = kernels.direct_limit(n)
-            tier = self._decide_exact_lazy(
-                lambda: kernels.group_tier(key_data, key_valid, alive, limit))
-            if tier == 1:
-                return kernels.dense_rank_direct(key_data, key_valid, alive,
-                                                 limit)
-            if tier == 2:
+            if self._decide_exact_lazy(
+                    lambda: kernels.group_tier(key_data, key_valid, alive)):
                 return kernels.dense_rank_packsort(key_data, key_valid, alive)
         return kernels.dense_rank(key_data, key_valid, alive)
 
@@ -986,8 +987,7 @@ class JaxExecutor:
             vals_s, valid_s = kernels.window_ordered_core(
                 gid[perm], [d[perm] for d in okd], [v[perm] for v in okv],
                 sarg, func)
-            data = jnp.zeros(n, vals_s.dtype).at[perm].set(vals_s)
-            dvalid = jnp.zeros(n, bool).at[perm].set(valid_s)
+            data, dvalid = kernels.unscatter(perm, (vals_s, valid_s))
         if arg_col is not None and is_dec(arg_col.dtype) and wf.func == "avg":
             data = data / 10.0 ** dec_scale(arg_col.dtype)  # descale
         pd = phys_dtype(wf.dtype)
@@ -1003,10 +1003,217 @@ class JaxExecutor:
                              for k in range(len(node.group_exprs), -1, -1)]
         else:
             grouping_sets = [list(range(len(node.group_exprs)))]
+        if self._sorted_agg_eligible(node, child, grouping_sets):
+            return self._aggregate_sorted(node, child, grouping_sets)
         pieces = [self._aggregate_one_sharded(node, child, keep)
                   if self._mesh_agg_eligible(node, keep)
                   else self._aggregate_one(node, child, keep)
                   for keep in grouping_sets]
+        if len(pieces) == 1:
+            return pieces[0]
+        return _concat_dtables(pieces, list(node.out_names))
+
+    def _sorted_agg_eligible(self, node: AggregateNode, child: DTable,
+                             grouping_sets: list) -> bool:
+        """Static gate for the sorted aggregation path: ONE key sort shared
+        by every rollup prefix level, within-group scans instead of the
+        serialized segment scatters, S-sized gathers for output assembly.
+        Single-device only (the mesh path has its own shard-local plan)."""
+        if self._mesh is not None or child.capacity < (1 << 13):
+            return False
+        if not node.group_exprs:
+            return False          # global aggregate: masked reduces suffice
+        for s in node.aggs:
+            if s.distinct or s.func not in (
+                    "count_star", "count", "sum", "min", "max", "avg",
+                    "stddev_samp"):
+                return False
+            if s.arg is not None and s.arg.dtype == "str":
+                return False
+        return True
+
+    def _aggregate_sorted(self, node: AggregateNode, child: DTable,
+                          grouping_sets: list) -> DTable:
+        n = child.capacity
+        alive = child.alive
+        group_cols = [self._eval(e, child) for e in node.group_exprs]
+        keys = [rank_key(c) for c in group_cols]
+        kvalids = [c.valid for c in group_cols]
+        arg_cols = [None if s.arg is None else self._eval(s.arg, child)
+                    for s in node.aggs]
+        x64 = jax.config.read("jax_enable_x64")
+        fd = jnp.float64 if x64 else jnp.float32
+
+        tier = self._decide_exact_lazy(
+            lambda: kernels.group_tier(keys, kvalids, alive))
+
+        # ---- ONE sort: keys (packed when possible) + agg args as payload,
+        # deduplicated by expression so SUM(x)/AVG(x) carry x once
+        payloads: list = []
+        pay_idx: list = []        # per spec: index into payloads or None
+        seen_args: dict[str, int] = {}
+        for s, ac in zip(node.aggs, arg_cols):
+            if ac is None:
+                pay_idx.append(None)
+                continue
+            akey = repr(s.arg)
+            if akey in seen_args:
+                pay_idx.append(seen_args[akey])
+                continue
+            seen_args[akey] = len(payloads)
+            pay_idx.append(len(payloads))
+            payloads.append(ac.canon().data)
+            payloads.append(ac.valid)
+        iota = jnp.arange(n, dtype=_I32)
+        if tier:
+            norms, ranges, _ = kernels._key_ranges(keys, kvalids, alive)
+            pd = kernels._pack_dtype()
+            pack = jnp.zeros(n, pd)
+            for norm, r in zip(norms, ranges):
+                pack = pack * r + norm.astype(pd)
+            key_ops = [jnp.where(alive, pack, jnp.iinfo(pd).max)]
+            nkey_ops = 1
+        else:
+            ranges = None
+            key_ops = [(~alive).astype(_I32)]
+            for d, v in zip(keys, kvalids):
+                key_ops.append((~v).astype(_I32))
+                key_ops.append(jnp.where(v & alive, d,
+                                         jnp.zeros((), d.dtype)))
+            nkey_ops = len(key_ops)
+        out = lax.sort(tuple(key_ops) + tuple(payloads) + (iota,),
+                       num_keys=nkey_ops, is_stable=True)
+        sorted_keys = out[:nkey_ops]
+        sorted_pays = out[nkey_ops:-1]
+        perm = out[-1]
+        iota_s = iota
+        alive_sorted = iota_s < jnp.sum(alive.astype(_I32))
+
+        def level_new_group(k: int) -> jax.Array:
+            first = alive_sorted & (iota_s == 0)
+            if k == 0:
+                return first
+            if ranges is not None:
+                stride = jnp.ones((), sorted_keys[0].dtype)
+                for r in ranges[k:]:
+                    stride = stride * r
+                ck = sorted_keys[0] // stride
+                diff = jnp.concatenate([jnp.ones(1, bool),
+                                        ck[1:] != ck[:-1]])
+            else:
+                diff = jnp.zeros(n, bool)
+                for i in range(k):
+                    for op in (sorted_keys[1 + 2 * i],
+                               sorted_keys[2 + 2 * i]):
+                        diff = diff | jnp.concatenate(
+                            [jnp.ones(1, bool), op[1:] != op[:-1]])
+            return (alive_sorted & diff) | first
+
+        pieces: list[DTable] = []
+        for keep in grouping_sets:
+            k = len(keep)
+            if k == 0:
+                # grand total: one group — the masked-reduce path is exact
+                # and cheap, and handles the empty-input one-row semantics
+                pieces.append(self._aggregate_one(node, child, keep))
+                continue
+            new_group = level_new_group(k)
+            gid_sorted = jnp.cumsum(new_group.astype(_I32)) - 1
+            num_groups_t = jnp.max(jnp.where(alive_sorted, gid_sorted, -1)) + 1
+            cap_out = bucket(max(self._decide_cap(num_groups_t), 1))
+            is_end = kernels.group_ends(new_group, alive_sorted)
+            end_perm, _ = kernels.compaction_perm(is_end)
+            sel = end_perm[:cap_out]
+            orig = perm[sel]
+            alive_out = jnp.arange(cap_out, dtype=_I32) < num_groups_t
+
+            out_cols: list[DCol] = []
+            for i, gc in enumerate(group_cols):
+                if i < k:
+                    cd = gc.canon().data
+                    out_cols.append(DCol(gc.dtype, cd[orig],
+                                         gc.valid[orig] & alive_out,
+                                         gc.dictionary))
+                else:
+                    out_cols.append(DCol(
+                        gc.dtype, jnp.zeros(cap_out, phys_dtype(gc.dtype)),
+                        jnp.zeros(cap_out, bool), gc.dictionary))
+
+            ones_i = jnp.where(alive_sorted, 1, 0).astype(_I32)
+            for spec, ac, pi in zip(node.aggs, arg_cols, pay_idx):
+                if spec.func == "count_star":
+                    cnt_s = kernels.sorted_agg_scan(ones_i, new_group,
+                                                    jnp.add)
+                    int_out = jnp.int64 if x64 else _I32
+                    vals = cnt_s[sel].astype(int_out)
+                    out_cols.append(DCol(spec.dtype,
+                                         vals.astype(phys_dtype(spec.dtype)),
+                                         jnp.ones(cap_out, bool)))
+                    continue
+                data_s = sorted_pays[pi]
+                valid_s = sorted_pays[pi + 1] & alive_sorted
+                contrib_i = valid_s.astype(
+                    jnp.int64 if x64 else _I32)
+                cnt_s = kernels.sorted_agg_scan(contrib_i, new_group, jnp.add)
+                cnt_sel = cnt_s[sel]
+                func = spec.func
+                if func == "count":
+                    out_cols.append(DCol(
+                        spec.dtype, cnt_sel.astype(phys_dtype(spec.dtype)),
+                        jnp.ones(cap_out, bool)))
+                    continue
+                int_in = jnp.issubdtype(data_s.dtype, jnp.integer)
+                if func in ("sum", "avg"):
+                    acc = data_s.dtype if (int_in and (func == "sum" or x64)) \
+                        else fd
+                    w = jnp.where(valid_s, data_s.astype(acc),
+                                  jnp.zeros((), acc))
+                    sum_sel = kernels.sorted_agg_scan(w, new_group,
+                                                      jnp.add)[sel]
+                    if func == "sum":
+                        vals = sum_sel
+                        dvalid = cnt_sel > 0
+                    else:
+                        vals = (sum_sel.astype(fd) /
+                                jnp.maximum(cnt_sel, 1).astype(fd))
+                        dvalid = cnt_sel > 0
+                elif func in ("min", "max"):
+                    ext = kernels._extreme(data_s.dtype, func)
+                    w = jnp.where(valid_s, data_s, ext)
+                    op = jnp.minimum if func == "min" else jnp.maximum
+                    vals = kernels.sorted_agg_scan(w, new_group, op)[sel]
+                    dvalid = cnt_sel > 0
+                    vals = jnp.where(dvalid, vals,
+                                     jnp.zeros((), data_s.dtype))
+                else:           # stddev_samp
+                    zf = jnp.where(valid_s, data_s, 0).astype(fd)
+                    s1 = (kernels.sorted_agg_scan(
+                        jnp.where(valid_s, data_s,
+                                  jnp.zeros((), data_s.dtype)), new_group,
+                        jnp.add)[sel].astype(fd) if int_in and x64 else
+                        kernels.sorted_agg_scan(zf, new_group, jnp.add)[sel])
+                    s2 = kernels.sorted_agg_scan(zf * zf, new_group,
+                                                 jnp.add)[sel]
+                    nf = cnt_sel.astype(fd)
+                    var = (s2 - s1 * s1 / jnp.maximum(nf, 1.0)) / \
+                        jnp.maximum(nf - 1.0, 1.0)
+                    vals = jnp.sqrt(jnp.maximum(var, 0.0))
+                    dvalid = cnt_sel > 1
+                if ac is not None and is_dec(ac.dtype) and \
+                        spec.func in ("avg", "stddev_samp"):
+                    vals = vals / 10.0 ** dec_scale(ac.dtype)
+                out_cols.append(DCol(spec.dtype,
+                                     vals.astype(phys_dtype(spec.dtype)),
+                                     dvalid & alive_out))
+            if node.rollup:
+                gid_val = sum(1 << (len(node.group_exprs) - 1 - i)
+                              for i in range(len(node.group_exprs))
+                              if i >= k)
+                out_cols.append(DCol("int",
+                                     jnp.full(cap_out, gid_val,
+                                              phys_dtype("int")),
+                                     jnp.ones(cap_out, bool)))
+            pieces.append(DTable(list(node.out_names), out_cols, alive_out))
         if len(pieces) == 1:
             return pieces[0]
         return _concat_dtables(pieces, list(node.out_names))
@@ -1389,6 +1596,12 @@ class JaxExecutor:
             if out is not None:
                 return out
 
+        if self._mesh is not None and kind == "inner":
+            out = self._mesh_shuffle_join(node, left, right, lkeys, rkeys,
+                                          lvalid, rvalid)
+            if out is not None:
+                return out
+
         key_data = []
         for lc, rc in zip(lkeys, rkeys):
             ld, rd = _joinable_pair(lc, rc)
@@ -1455,6 +1668,89 @@ class JaxExecutor:
             pieces.append(_null_extend_left(left, right, unmatched_r,
                                             names=list(node.out_names)))
         return _concat_dtables(pieces, list(node.out_names))
+
+    def _mesh_shuffle_join(self, node: JoinNode, left: DTable, right: DTable,
+                           lkeys: list, rkeys: list, lvalid, rvalid
+                           ) -> Optional[DTable]:
+        """Partitioned shuffle join for fact-fact joins on a mesh: hash-
+        repartition BOTH sides by the join key (all_to_all of bounded
+        blocks), then join shard-locally — the fact sides never gather
+        (Spark shuffle join; SURVEY.md §2 parallelism table last row).
+        GSPMD's fallback for the generic sort-based join pulls fact-sized
+        buffers to every device. Eligibility is static, so record and
+        replay take the same branch; capacities (max hash-block size, max
+        per-shard match count) are recorded schedule decisions."""
+        from ...parallel import dist_ops
+
+        mesh = self._mesh
+        nsh = mesh.devices.size
+        lcap, rcap = left.capacity, right.capacity
+        if min(lcap, rcap) < max(self._shard_min_rows, nsh) \
+                or lcap % nsh or rcap % nsh:
+            return None
+        if any(c.parts is not None for c in left.cols + right.cols):
+            return None
+        pairs = [_joinable_pair(a, b) for a, b in zip(lkeys, rkeys)]
+        if not pairs or any(not jnp.issubdtype(a.dtype, jnp.integer)
+                            for a, _ in pairs):
+            return None
+        lkd = [a for a, _ in pairs]
+        rkd = [b for _, b in pairs]
+        l_ok = left.alive & lvalid
+        r_ok = right.alive & rvalid
+
+        def repart(kd, ok, cols):
+            cap = int(ok.shape[0])
+            shard_rows = cap // nsh
+            iota = jnp.arange(cap, dtype=_I32)
+            dest = dist_ops._multi_hash(kd, nsh)
+            pair_id = jnp.where(ok, (iota // shard_rows) * nsh + dest,
+                                nsh * nsh)
+            sizes = jax.ops.segment_sum(
+                ok.astype(_I32), pair_id,
+                num_segments=nsh * nsh + 1)[:nsh * nsh]
+            per_pair = bucket(max(self._decide_cap(jnp.max(sizes)), 1))
+            fn = dist_ops.repartition_by_key(mesh, per_pair)
+            out_flat, out_alive, _, overflow = fn(list(kd) + list(cols),
+                                                  ok, list(kd))
+            # per_pair covers the recorded max block; drift re-records
+            self._decide_exact(overflow)
+            return out_flat[:len(kd)], out_flat[len(kd):], out_alive
+
+        l_flat = [x for c in left.cols for x in (c.data, c.valid)]
+        r_flat = [x for c in right.cols for x in (c.data, c.valid)]
+        lkd2, l_cols2, l_al2 = repart(lkd, l_ok, l_flat)
+        rkd2, r_cols2, r_al2 = repart(rkd, r_ok, r_flat)
+
+        counts, lo, cnt, perm_r = dist_ops.shuffle_join_counts(mesh)(
+            tuple(lkd2), l_al2, tuple(rkd2), r_al2)
+        cap_out_shard = bucket(max(self._decide_cap(jnp.max(counts)), 1))
+        out_l, out_r, out_alive = dist_ops.shuffle_join_expand(
+            mesh, cap_out_shard)(lo, cnt, perm_r, l_al2,
+                                 tuple(l_cols2), tuple(r_cols2))
+
+        def rebuild(cols_src, flat):
+            out = []
+            for i, c in enumerate(cols_src):
+                out.append(DCol(c.dtype, flat[2 * i],
+                                flat[2 * i + 1].astype(bool), c.dictionary))
+            return out
+        cols = rebuild(left.cols, list(out_l)) + rebuild(right.cols,
+                                                         list(out_r))
+        out = DTable(self._combined_names(node, len(cols)), cols, out_alive)
+        return self._apply_residual(node.residual, out)
+
+    @staticmethod
+    def _combined_names(node: JoinNode, ncols: int) -> list[str]:
+        return list(node.out_names) if len(node.out_names) == ncols \
+            else [f"__c{i}" for i in range(ncols)]
+
+    def _apply_residual(self, residual, out: DTable) -> DTable:
+        if residual is None:
+            return out
+        mask = jexprs.evaluate(residual, out, subquery_eval=self._ectx())
+        return DTable(out.names, out.cols,
+                      kernels.filter_alive(out.alive, mask.data, mask.valid))
 
     def _fast_join(self, node: JoinNode, left: DTable, right: DTable,
                    lkey: DCol, rkey: DCol, l_ok: jax.Array, r_ok: jax.Array,
@@ -1560,13 +1856,8 @@ class JaxExecutor:
         right_rows = perm_r[jnp.clip(build_pos, 0, right.capacity - 1)]
         cols = [_gather_col(c, left_idx) for c in left.cols] + \
                [_gather_col(c, right_rows) for c in right.cols]
-        names = list(node.out_names) if len(node.out_names) == len(cols) \
-            else [f"__c{i}" for i in range(len(cols))]
-        out = DTable(names, cols, alive_out)
-        if residual is not None:
-            mask = jexprs.evaluate(residual, out, subquery_eval=self._ectx())
-            out = DTable(out.names, out.cols,
-                         kernels.filter_alive(out.alive, mask.data, mask.valid))
+        out = DTable(self._combined_names(node, len(cols)), cols, alive_out)
+        out = self._apply_residual(residual, out)
         return out, left_idx, right_rows
 
 

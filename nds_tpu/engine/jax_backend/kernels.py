@@ -66,14 +66,23 @@ def dense_rank(key_data: list[jax.Array], key_valid: list[jax.Array],
     return _gid_from_sorted(new_group, alive_sorted, perm, n)
 
 
+def unscatter(perm: jax.Array, values: tuple) -> tuple:
+    """Undo a permutation WITHOUT scatter: sort by `perm` (which is a
+    permutation of 0..n-1, so sorting restores original row order) carrying
+    `values` as payload operands. Measured on TPU: an n-sized scatter costs
+    ~60x a 2-operand sort — .at[perm].set() is the single most expensive
+    way to invert a permutation on this hardware."""
+    out = lax.sort((perm,) + tuple(values), num_keys=1, is_stable=True)
+    return out[1:]
+
+
 def _gid_from_sorted(new_group: jax.Array, alive_sorted: jax.Array,
                      perm: jax.Array, n: int) -> tuple[jax.Array, jax.Array]:
-    """Shared sorted->gid suffix: cumsum group opens, scatter back through
-    the sort permutation (dead rows hold the `n` sentinel)."""
+    """Shared sorted->gid suffix: cumsum group opens, sort-unscatter back
+    through the permutation (dead rows hold the `n` sentinel)."""
     gid_sorted = jnp.cumsum(new_group.astype(_I32)) - 1
     num_groups = jnp.max(jnp.where(alive_sorted, gid_sorted, -1)) + 1
-    gid = jnp.zeros(n, _I32).at[perm].set(
-        jnp.where(alive_sorted, gid_sorted, n))
+    (gid,) = unscatter(perm, (jnp.where(alive_sorted, gid_sorted, n),))
     return gid, num_groups
 
 
@@ -137,26 +146,20 @@ def _sat_product(ranges: list[jax.Array], cap: int) -> jax.Array:
     return p
 
 
-def direct_limit(capacity: int) -> int:
-    """Static scatter-table bound for the direct-address tier: generous
-    relative to the row count (the scatter+cumsum pass is O(limit))."""
-    return min(max(4 * capacity, 1 << 16), 1 << 23)
-
-
 def group_tier(key_data: list[jax.Array], key_valid: list[jax.Array],
-               alive: jax.Array, limit: int) -> jax.Array:
-    """Traced tier decision: 1 = direct-address, 2 = packed sort, 0 = the
-    generic multi-operand sort. Recorded as an exact schedule decision."""
+               alive: jax.Array) -> jax.Array:
+    """Traced packability decision: 1 = the key tuple packs into one
+    integer (single-key sort), 0 = the generic multi-operand sort.
+    Recorded as an exact schedule decision. (An earlier direct-address
+    scatter tier was removed: n-sized scatters measure ~60x a 2-operand
+    sort on TPU, so packability is the only distinction that matters.)"""
     _, ranges, oks = _key_ranges(key_data, key_valid, alive)
     ok = jnp.ones((), bool)
     for o in oks:
         ok = ok & o
     pack_cap = (1 << 62) if jax.config.read("jax_enable_x64") else (1 << 30)
-    p_direct = _sat_product(ranges, limit)
     p_pack = _sat_product(ranges, pack_cap)
-    tier = jnp.where(p_direct <= limit, 1,
-                     jnp.where(p_pack <= pack_cap, 2, 0))
-    return jnp.where(ok, tier, 0).astype(_I32)
+    return jnp.where(ok & (p_pack <= pack_cap), 1, 0).astype(_I32)
 
 
 def _pack_keys(key_data: list[jax.Array], key_valid: list[jax.Array],
@@ -172,22 +175,6 @@ def _pack_keys(key_data: list[jax.Array], key_valid: list[jax.Array],
     for norm, r in zip(norms, ranges):
         c = c * r + norm.astype(pd)
     return c
-
-
-def dense_rank_direct(key_data: list[jax.Array], key_valid: list[jax.Array],
-                      alive: jax.Array, limit: int
-                      ) -> tuple[jax.Array, jax.Array]:
-    """Tier-1 dense_rank: presence scatter + cumsum over the packed domain.
-    gid order matches the sort-based dense_rank exactly."""
-    n = alive.shape[0]
-    c = jnp.clip(_pack_keys(key_data, key_valid, alive), 0,
-                 limit - 1).astype(_I32)
-    pres = jnp.zeros(limit + 1, _I32).at[
-        jnp.where(alive, c, limit)].set(1)[:limit]
-    prefix = jnp.cumsum(pres)
-    num_groups = prefix[limit - 1]
-    gid = jnp.where(alive, prefix[c] - 1, n).astype(_I32)
-    return gid, num_groups
 
 
 def dense_rank_packsort(key_data: list[jax.Array], key_valid: list[jax.Array],
@@ -214,14 +201,12 @@ def filter_alive(alive: jax.Array, mask_data: jax.Array,
 
 def compaction_perm(alive: jax.Array) -> tuple[jax.Array, jax.Array]:
     """Stable permutation bringing alive rows to the front; returns
-    (perm, count). Scatter-based (cumsum positions), not a sort: TPU
-    lax.sort is O(log^2 n) merge passes and compaction runs after every
-    selective filter. Entries past `count` are unspecified valid indices
-    (callers mask by count)."""
+    (perm, count). Sort-based: a 2-operand lax.sort measures ~60x cheaper
+    than the n-sized scatter this used to do (TPU scatters serialize).
+    Entries past `count` are dead-row indices (callers mask by count)."""
     n = alive.shape[0]
-    pos = jnp.cumsum(alive.astype(_I32)) - 1
-    target = jnp.where(alive, pos, n)
-    perm = jnp.zeros(n + 1, _I32).at[target].set(_iota(n))[:n]
+    _, perm = lax.sort(((~alive).astype(_I32), _iota(n)), num_keys=1,
+                       is_stable=True)
     return perm, jnp.sum(alive.astype(_I32))
 
 
@@ -268,7 +253,22 @@ def sort_specs(keys: list[SortKey]) -> tuple:
 # aggregation
 # ---------------------------------------------------------------------------
 
+# below this segment count, a vectorized (S, n) masked reduce beats the
+# scatter-add that segment_sum lowers to by ~600x on TPU (scatters
+# serialize; the broadcast+select fuses into the reduction)
+_MASKED_SEG_MAX = 64
+
+
 def _seg(data: jax.Array, gid: jax.Array, num_segments: int, op: str) -> jax.Array:
+    if num_segments <= _MASKED_SEG_MAX:
+        seg_ids = jnp.arange(num_segments, dtype=gid.dtype)
+        mask = gid[None, :] == seg_ids[:, None]
+        if op == "sum":
+            return jnp.where(mask, data[None, :],
+                             jnp.zeros((), data.dtype)).sum(axis=1)
+        fill = _extreme(data.dtype, op)
+        red = jnp.min if op == "min" else jnp.max
+        return red(jnp.where(mask, data[None, :], fill), axis=1)
     if op == "sum":
         return jax.ops.segment_sum(data, gid, num_segments=num_segments)
     if op == "min":
@@ -287,14 +287,11 @@ def agg_apply(gid: jax.Array, alive: jax.Array, func: str, arg,
     """
     int_out = jnp.int64 if jax.config.read("jax_enable_x64") else _I32
     if func == "count_star":
-        ones = jnp.ones_like(alive, dtype=_I32)
-        vals = jax.ops.segment_sum(jnp.where(alive, ones, 0), gid,
-                                   num_segments=cap_out)
+        vals = _seg(jnp.where(alive, 1, 0).astype(_I32), gid, cap_out, "sum")
         return vals.astype(int_out), jnp.ones(cap_out, bool)
     data, valid = arg
     contrib = alive & valid
-    cnt = jax.ops.segment_sum(contrib.astype(int_out), gid,
-                              num_segments=cap_out)
+    cnt = _seg(contrib.astype(int_out), gid, cap_out, "sum")
     if func == "count":
         return cnt, jnp.ones(cap_out, bool)
     if func == "sum":
@@ -355,7 +352,15 @@ def _extreme(dtype, func: str):
 def group_representatives(gid: jax.Array, alive: jax.Array,
                           data: jax.Array, valid: jax.Array,
                           cap_out: int) -> tuple[jax.Array, jax.Array]:
-    """Per-group key value (all rows in a group share it): scatter any row."""
+    """Per-group key value (all rows in a group share it)."""
+    if cap_out <= _MASKED_SEG_MAX and data.dtype != jnp.bool_:
+        # masked max-reduce (any row works: the group shares the value);
+        # avoids the serialized n-sized scatter
+        filled = jnp.where(alive, data, _extreme(data.dtype, "max"))
+        vals = _seg(filled, gid, cap_out, "max")
+        occupied = _seg(alive.astype(_I32), gid, cap_out, "max") > 0
+        pvalid = _seg((alive & valid).astype(_I32), gid, cap_out, "max") > 0
+        return jnp.where(occupied, vals, jnp.zeros((), data.dtype)), pvalid
     safe_gid = jnp.where(alive, gid, cap_out)
     padded_vals = jnp.zeros(cap_out + 1, dtype=data.dtype).at[safe_gid].set(data)
     padded_valid = jnp.zeros(cap_out + 1, dtype=bool).at[safe_gid].set(valid)
@@ -375,6 +380,26 @@ def distinct_within_group(gid: jax.Array, alive: jax.Array,
     first = jnp.full(n + 1, n, dtype=_I32).at[
         jnp.where(alive & valid, pair_gid, n)].min(_iota(n))
     return (alive & valid) & (first[pair_gid] == _iota(n))
+
+
+# ---------------------------------------------------------------------------
+# sorted aggregation: scans over key-sorted rows instead of segment scatters
+# ---------------------------------------------------------------------------
+
+def sorted_agg_scan(vals: jax.Array, new_group: jax.Array, op) -> jax.Array:
+    """Inclusive within-group scan over KEY-SORTED rows (group totals sit at
+    group-end rows). This is the scatter-free replacement for
+    segment_sum/min/max: TPU segment_* lowers to serialized scatter-adds
+    (~100ns/row measured); a log-depth associative scan is ~25x cheaper."""
+    return _seg_scan(vals, new_group, op)
+
+
+def group_ends(new_group: jax.Array, alive_sorted: jax.Array) -> jax.Array:
+    """Row mask of each group's LAST alive row in sorted order."""
+    n = new_group.shape[0]
+    next_new = jnp.concatenate([new_group[1:], jnp.ones(1, bool)])
+    next_dead = jnp.concatenate([~alive_sorted[1:], jnp.ones(1, bool)])
+    return alive_sorted & (next_new | next_dead)
 
 
 # ---------------------------------------------------------------------------

@@ -353,8 +353,9 @@ class Session:
                     self._stream_cache[query] = None
                     return None  # not device-runnable; use the normal path
                 decisions = streaming.inflate_schedule(decisions, morsel_rows)
-                sent["cq"] = CompiledQuery(sp.partial_plan, decisions,
-                                           scan_keys, mesh=jexec._mesh)
+                sent["cq"] = CompiledQuery(
+                    sp.partial_plan, decisions, scan_keys, mesh=jexec._mesh,
+                    shard_min_rows=jexec._shard_min_rows)
                 sent["ent"] = {"scan_keys": scan_keys}
                 sent["mkey"] = next(
                     k for k in scan_keys

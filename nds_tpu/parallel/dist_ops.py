@@ -96,7 +96,8 @@ def repartition_by_key(mesh: Mesh, per_pair_capacity: int):
         def place(col_sorted):
             buf = jnp.zeros((n_shards * per_pair_capacity + 1,),
                             col_sorted.dtype)
-            return buf.at[flat].set(jnp.where(ok, col_sorted, 0)
+            zero = jnp.zeros((), col_sorted.dtype)   # keep bool cols bool
+            return buf.at[flat].set(jnp.where(ok, col_sorted, zero)
                                     )[:n_shards * per_pair_capacity]
 
         out_cols = [place(c[order]) for c in cols]
@@ -117,6 +118,63 @@ def repartition_by_key(mesh: Mesh, per_pair_capacity: int):
     return shard_map(local, mesh=mesh,
                      in_specs=(P(axis), P(axis), P(axis)),
                      out_specs=(P(axis), P(axis), P(axis), P()))
+
+
+def _local_join_ranges(lkd, lal, rkd, ral):
+    """Per-shard probe ranges for a co-partitioned join block (the generic
+    sort-based machinery, shard-local): returns (lo, cnt, perm_r)."""
+    lcap, rcap = lal.shape[0], ral.shape[0]
+    kd = [jnp.concatenate([a, b]) for a, b in zip(lkd, rkd)]
+    al = jnp.concatenate([lal, ral])
+    gid, _ = kernels.dense_rank(
+        kd, [jnp.ones(lcap + rcap, bool)] * len(kd), al)
+    l_gid, r_gid = gid[:lcap], gid[lcap:]
+    _, perm_r = kernels.build_side(
+        jnp.where(al[lcap:], r_gid, jnp.iinfo(_I32).max), ral)
+    lo, cnt = kernels.probe_counts_by_gid(r_gid, ral, l_gid, lal,
+                                          gid_cap=lcap + rcap)
+    return lo, cnt, perm_r
+
+
+def shuffle_join_counts(mesh: Mesh):
+    """Jittable per-shard probe ranges + match totals of a co-partitioned
+    (repartitioned) join: (lkeys, lalive, rkeys, ralive) -> ((n_shards,)
+    counts, lo, cnt, perm_r) — the ranges feed shuffle_join_expand so the
+    dominant per-shard sort happens ONCE."""
+    axis = mesh.axis_names[0]
+
+    def local(lkd, lal, rkd, ral):
+        lo, cnt, perm_r = _local_join_ranges(list(lkd), lal, list(rkd), ral)
+        return jnp.sum(cnt).reshape(1), lo, cnt, perm_r
+
+    return shard_map(local, mesh=mesh,
+                     in_specs=(P(axis), P(axis), P(axis), P(axis)),
+                     out_specs=(P(axis),) * 4, check_vma=False)
+
+
+def shuffle_join_expand(mesh: Mesh, cap_out_shard: int):
+    """Jittable shard-local inner-join expansion over co-partitioned sides,
+    reusing the probe ranges from shuffle_join_counts.
+
+    (lo, cnt, perm_r, lalive, lcols, rcols) -> (out_lcols, out_rcols,
+    out_alive), each sharded with cap_out_shard rows per shard. Together
+    with repartition_by_key this is the Spark partitioned shuffle join
+    (SURVEY.md §2 parallelism table last row): only hash-routed blocks ride
+    the ICI — the fact sides are never gathered."""
+    axis = mesh.axis_names[0]
+
+    def local(lo, cnt, perm_r, lal, lcols, rcols):
+        rcap = perm_r.shape[0]
+        left_idx, build_pos, alive_out = kernels.expand_join(
+            lo, cnt, lal, cap_out_shard)
+        right_rows = perm_r[jnp.clip(build_pos, 0, rcap - 1)]
+        out_l = tuple(c[left_idx] for c in lcols)
+        out_r = tuple(c[right_rows] for c in rcols)
+        return out_l, out_r, alive_out
+
+    return shard_map(local, mesh=mesh,
+                     in_specs=(P(axis),) * 6,
+                     out_specs=(P(axis), P(axis), P(axis)), check_vma=False)
 
 
 def _partial_agg(spec: str, v, contrib, gid, n_partial):

@@ -38,13 +38,79 @@ def _is_float_type(t) -> bool:
     return (pa.types.is_floating(t) or pa.types.is_decimal(t))
 
 
-def _read_output(path: str):
+def _output_files(path: str):
     files = sorted(glob.glob(os.path.join(path, "*.parquet")))
-    if not files:
-        return None
-    tables = [pq.read_table(f) for f in files]
+    return files or None
+
+
+def _output_rowcount(files: list[str]) -> int:
+    """Row count from parquet metadata only (no data read)."""
+    return sum(pq.ParquetFile(f).metadata.num_rows for f in files)
+
+
+def _batch_rows(batch):
+    cols = [batch.column(i).to_pylist() for i in range(batch.num_columns)]
+    return list(zip(*cols)) if cols else []
+
+
+def _sort_key_fn(schema):
+    """Row sort key over non-float columns (reference collect_results
+    :116-144 sorts on non-float columns before iterating)."""
+    float_cols = {i for i, f in enumerate(schema)
+                  if _is_float_type(f.type)}
+
+    def key(row):
+        return tuple((v is None, "" if v is None else str(v))
+                     for i, v in enumerate(row) if i not in float_cols)
+    return key
+
+
+def iter_output_rows(files: list[str], ignore_ordering: bool,
+                     batch_rows: int = 1 << 16, merge_batch: int = 4096):
+    """Stream rows of an output tree with BOUNDED memory (the reference
+    switches to toLocalIterator for large outputs, nds/nds_validate.py:
+    116-144; here a no-LIMIT SF100 output must not materialize).
+
+    ignore_ordering: external merge sort — each batch sorts in memory and
+    spills as a run; runs k-way-merge (stable, so the total order matches
+    the in-memory stable sort the small-output path used)."""
+    import heapq
+    import shutil
+    import tempfile
+
     import pyarrow as pa
-    return pa.concat_tables(tables)
+
+    if not ignore_ordering:
+        for f in files:
+            for batch in pq.ParquetFile(f).iter_batches(batch_rows):
+                yield from _batch_rows(batch)
+        return
+
+    schema = pq.ParquetFile(files[0]).schema_arrow
+    key = _sort_key_fn(schema)
+    tmp = tempfile.mkdtemp(prefix="nds_validate_")
+    try:
+        runs: list[str] = []
+        for f in files:
+            for batch in pq.ParquetFile(f).iter_batches(batch_rows):
+                rows = _batch_rows(batch)
+                rows.sort(key=key)
+                run = os.path.join(tmp, f"run-{len(runs)}.parquet")
+                cols = list(zip(*rows)) if rows else [
+                    [] for _ in schema.names]
+                pq.write_table(
+                    pa.table({n: pa.array(list(c), type=t.type)
+                              for n, t, c in zip(schema.names, schema,
+                                                 cols)}), run)
+                runs.append(run)
+
+        def run_iter(path):
+            for batch in pq.ParquetFile(path).iter_batches(merge_batch):
+                yield from _batch_rows(batch)
+
+        yield from heapq.merge(*(run_iter(r) for r in runs), key=key)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def compare(expected, actual, epsilon: float = DEFAULT_EPSILON) -> bool:
@@ -85,37 +151,24 @@ def row_equal(row_e, row_a, query_name: str, names: list[str]) -> bool:
     return True
 
 
-def collect_rows(table, ignore_ordering: bool):
-    cols = [table.column(i).to_pylist() for i in range(table.num_columns)]
-    rows = list(zip(*cols)) if cols else []
-    if ignore_ordering:
-        float_cols = {i for i, f in enumerate(table.schema)
-                      if _is_float_type(f.type)}
-        def key(row):
-            return tuple(
-                (v is None, "" if v is None else str(v))
-                for i, v in enumerate(row) if i not in float_cols)
-        rows.sort(key=key)
-    return rows
-
-
 def compare_results(path_expected: str, path_actual: str, query_name: str,
                     ignore_ordering: bool = False,
                     epsilon: float = DEFAULT_EPSILON) -> bool:
-    te = _read_output(os.path.join(path_expected, query_name))
-    ta = _read_output(os.path.join(path_actual, query_name))
-    if te is None or ta is None:
+    fe = _output_files(os.path.join(path_expected, query_name))
+    fa = _output_files(os.path.join(path_actual, query_name))
+    if fe is None or fa is None:
         print(f"{query_name}: missing output "
-              f"(expected={te is not None}, actual={ta is not None})")
+              f"(expected={fe is not None}, actual={fa is not None})")
         return False
-    if te.num_rows != ta.num_rows:
-        print(f"{query_name}: row count differs "
-              f"{te.num_rows} vs {ta.num_rows}")
+    ne, na = _output_rowcount(fe), _output_rowcount(fa)
+    if ne != na:
+        print(f"{query_name}: row count differs {ne} vs {na}")
         return False
-    rows_e = collect_rows(te, ignore_ordering)
-    rows_a = collect_rows(ta, ignore_ordering)
+    names = pq.ParquetFile(fe[0]).schema_arrow.names
+    rows_e = iter_output_rows(fe, ignore_ordering)
+    rows_a = iter_output_rows(fa, ignore_ordering)
     for i, (re_, ra) in enumerate(zip(rows_e, rows_a)):
-        if not row_equal(re_, ra, query_name, te.column_names):
+        if not row_equal(re_, ra, query_name, names):
             print(f"{query_name}: row {i} differs\n  e: {re_}\n  a: {ra}")
             return False
     return True

@@ -115,3 +115,42 @@ def test_ci_pipeline_script_runs():
     for job in wf["jobs"].values():
         assert any("run_ci.sh" in str(step.get("run", ""))
                    for step in job["steps"])
+
+
+def test_validator_streams_with_external_sort(tmp_path):
+    """compare_results must stream (bounded batches, external merge sort
+    under --ignore_ordering) and agree with an in-memory sorted compare."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from nds_tpu import validate as V
+
+    rng = np.random.default_rng(2)
+    n = 5000
+    k = rng.integers(0, 500, n)
+    # float payload functionally determined by the sort key (like real
+    # query outputs: sorting only non-float cols leaves ties otherwise)
+    v = np.round(k * 0.517, 3)
+    for side, order in (("e", np.argsort(k, kind="stable")),
+                        ("a", np.random.default_rng(3).permutation(n))):
+        d = tmp_path / side / "query1"
+        d.mkdir(parents=True)
+        # spread over several files to exercise multi-run merge
+        for i in range(4):
+            sl = slice(i * n // 4, (i + 1) * n // 4)
+            pq.write_table(pa.table({
+                "k": pa.array(k[order][sl], type=pa.int64()),
+                "v": pa.array(v[order][sl]),
+            }), d / f"part-{i}.parquet")
+    # tiny batches force many spill runs through the merge path
+    rows = list(V.iter_output_rows(
+        V._output_files(str(tmp_path / "a" / "query1")), True,
+        batch_rows=128, merge_batch=16))
+    keys = [r[0] for r in rows]
+    assert keys == sorted(keys, key=lambda x: (x is None, str(x)))
+    assert len(rows) == n
+    assert V.compare_results(str(tmp_path / "e"), str(tmp_path / "a"),
+                             "query1", ignore_ordering=True)
+    # ordering-sensitive compare must fail on the permuted side
+    assert not V.compare_results(str(tmp_path / "e"), str(tmp_path / "a"),
+                                 "query1", ignore_ordering=False)

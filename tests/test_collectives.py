@@ -83,3 +83,43 @@ def test_star_query_collectives_bounded(star_session):
     assert not too_big, \
         "fact-capacity collectives found:\n" + "\n".join(
             f"  {n}: {l}" for n, l in too_big)
+
+
+@pytest.fixture(scope="module")
+def factfact_session():
+    rng = np.random.default_rng(23)
+    s = Session(EngineConfig(mesh_shape=(8,), shard_min_rows=8192))
+    n = N_FACT
+    s.register_arrow("orders", pa.table({
+        "ok": rng.integers(0, n // 4, n).astype(np.int64),
+        "site": rng.integers(0, 7, n).astype(np.int64),
+        "amt": rng.integers(1, 100, n).astype(np.int64),
+    }))
+    s.register_arrow("returns_", pa.table({
+        "rk": rng.integers(0, n // 4, n).astype(np.int64),
+        "rsite": rng.integers(0, 7, n).astype(np.int64),
+    }))
+    return s
+
+
+def test_fact_fact_join_shuffles_not_gathers(factfact_session):
+    """q64/q78/q95-class fact-fact joins on the mesh must repartition via
+    all_to_all (Spark shuffle join), never rebuild a fact side with a
+    capacity-sized all-gather (round-3 verdict #5)."""
+    s = factfact_session
+    sql = ("SELECT o.site, count(*), sum(o.amt) FROM orders o, returns_ r "
+           "WHERE o.ok = r.rk AND o.site <> r.rsite GROUP BY o.site")
+    expected = sorted(s.sql(sql, backend="numpy").to_pylist(), key=repr)
+    s.sql(sql, backend="jax")
+    got = sorted(s.sql(sql, backend="jax").to_pylist(), key=repr)
+    assert s.last_exec_stats.get("mode") in ("compiled", "compile+run")
+    assert got == expected
+    assert s.last_fallbacks == []
+
+    hlo = s._jax_executor().compiled_hlo(("sql", sql))
+    assert hlo is not None
+    gathers = [(nelem, line) for nelem, line in _collective_volumes(hlo)
+               if "all-gather" in line and nelem >= N_FACT // 2]
+    assert not gathers, \
+        "fact-capacity all-gathers found:\n" + "\n".join(
+            f"  {n}: {l}" for n, l in gathers)

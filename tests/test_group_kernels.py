@@ -28,33 +28,28 @@ def _random_keys(rng, n, spec):
 
 
 CASES = [
-    # single small-domain key -> tier 1
+    # single small-domain key
     ([(0, 50, 0.0)], 1),
-    # two keys with nulls, product fits the direct table -> tier 1
+    # two keys with nulls
     ([(10, 200, 0.1), (-5, 40, 0.2)], 1),
-    # offset-heavy key (big values, small span) -> tier 1
+    # offset-heavy key (big values, small span)
     ([(10**9, 10**9 + 1000, 0.05)], 1),
-    # wide multi-key (q67-class): product overflows the table but packs -> 2
+    # wide multi-key (q67-class): wide domain but packs into 63 bits
     ([(0, 20000, 0.0), (0, 1000, 0.1), (0, 100, 0.0), (0, 12, 0.0),
-      (0, 2000, 0.0)], 2),
+      (0, 2000, 0.0)], 1),
 ]
 
 
 @pytest.mark.parametrize("spec,want_tier", CASES, ids=range(len(CASES)))
-def test_tiers_match_sort_based(spec, want_tier):
+def test_packsort_matches_sort_based(spec, want_tier):
     rng = np.random.default_rng(11)
     n = 1 << 14
     key_data, key_valid = _random_keys(rng, n, spec)
     alive = jnp.asarray(rng.random(n) < 0.9)
-    limit = kernels.direct_limit(n)
-    tier = int(kernels.group_tier(key_data, key_valid, alive, limit))
+    tier = int(kernels.group_tier(key_data, key_valid, alive))
     assert tier == want_tier
     gid0, ng0 = kernels.dense_rank(key_data, key_valid, alive)
-    if tier == 1:
-        gid1, ng1 = kernels.dense_rank_direct(key_data, key_valid, alive,
-                                              limit)
-    else:
-        gid1, ng1 = kernels.dense_rank_packsort(key_data, key_valid, alive)
+    gid1, ng1 = kernels.dense_rank_packsort(key_data, key_valid, alive)
     assert int(ng0) == int(ng1)
     np.testing.assert_array_equal(np.asarray(gid0), np.asarray(gid1))
 
@@ -67,23 +62,19 @@ def test_tier0_when_domain_unpackable():
     key_data = [jnp.asarray(d), jnp.asarray(rng.integers(0, 10**9, n))]
     key_valid = [jnp.ones(n, bool), jnp.ones(n, bool)]
     alive = jnp.ones(n, bool)
-    tier = int(kernels.group_tier(key_data, key_valid, alive,
-                                  kernels.direct_limit(n)))
-    assert tier == 0
+    assert int(kernels.group_tier(key_data, key_valid, alive)) == 0
 
 
 def test_all_dead_and_all_null():
     n = 1 << 13
     key_data = [jnp.zeros(n, jnp.int64)]
-    limit = kernels.direct_limit(n)
     for valid, alive in [
         (jnp.zeros(n, bool), jnp.ones(n, bool)),    # all null
         (jnp.ones(n, bool), jnp.zeros(n, bool)),    # all dead
     ]:
         gid0, ng0 = kernels.dense_rank(key_data, [valid], alive)
-        tier = int(kernels.group_tier(key_data, [valid], alive, limit))
-        assert tier == 1
-        gid1, ng1 = kernels.dense_rank_direct(key_data, [valid], alive, limit)
+        assert int(kernels.group_tier(key_data, [valid], alive)) == 1
+        gid1, ng1 = kernels.dense_rank_packsort(key_data, [valid], alive)
         assert int(ng0) == int(ng1)
         np.testing.assert_array_equal(np.asarray(gid0), np.asarray(gid1))
 

@@ -716,11 +716,11 @@ class JaxExecutor:
     def _dense_rank(self, key_data: list, key_valid: list,
                     alive) -> tuple:
         """dense_rank with record-time fast-tier selection (kernels.group_tier):
-        direct-address scatter or packed single-key sort replace the
-        multi-operand lax.sort when the key domain fits. Static gates keep
-        record and replay on the same schedule; the mesh path stays on the
-        generic kernel (scatter/cumsum over a replicated domain table would
-        force GSPMD gathers)."""
+        the packed single-key sort replaces the multi-operand lax.sort when
+        the key domain fits the integer dtype. Static gates keep record and
+        replay on the same schedule; the mesh path stays on the generic
+        kernel (pack ranges are data-dependent reductions that would force
+        GSPMD gathers)."""
         n = int(alive.shape[0])
         if (self._mesh is None and key_data and n >= (1 << 13)
                 and all(jnp.issubdtype(d.dtype, jnp.integer)
@@ -1044,8 +1044,13 @@ class JaxExecutor:
         x64 = jax.config.read("jax_enable_x64")
         fd = jnp.float64 if x64 else jnp.float32
 
+        # the pack probe only handles integer rank keys (float group keys —
+        # legal SQL — have no iinfo range); static gate so record and replay
+        # stay on one schedule
+        int_keys = all(jnp.issubdtype(k.dtype, jnp.integer) for k in keys)
         tier = self._decide_exact_lazy(
-            lambda: kernels.group_tier(keys, kvalids, alive))
+            lambda: kernels.group_tier(keys, kvalids, alive)) if int_keys \
+            else self._decide_exact(jnp.zeros((), _I32))
 
         # ---- ONE sort: keys (packed when possible) + agg args as payload,
         # deduplicated by expression so SUM(x)/AVG(x) carry x once
@@ -1706,11 +1711,15 @@ class JaxExecutor:
             dest = dist_ops._multi_hash(kd, nsh)
             pair_id = jnp.where(ok, (iota // shard_rows) * nsh + dest,
                                 nsh * nsh)
-            sizes = jax.ops.segment_sum(
-                ok.astype(_I32), pair_id,
-                num_segments=nsh * nsh + 1)[:nsh * nsh]
+            # _seg picks per mode: masked fused reduce under trace (a
+            # fact-sized segment_sum scatter would serialize inside every
+            # compiled run), O(n) segment_sum on the eager record pass (the
+            # masked form would materialize an (nsh^2, n) intermediate).
+            # The dead-row sentinel id nsh*nsh falls outside num_segments
+            # and drops out on either path.
+            sizes = kernels._seg(ok.astype(_I32), pair_id, nsh * nsh, "sum")
             per_pair = bucket(max(self._decide_cap(jnp.max(sizes)), 1))
-            fn = dist_ops.repartition_by_key(mesh, per_pair)
+            fn = dist_ops.repartition_by_key(mesh, per_pair, emit_key=False)
             out_flat, out_alive, _, overflow = fn(list(kd) + list(cols),
                                                   ok, list(kd))
             # per_pair covers the recorded max block; drift re-records

@@ -92,16 +92,13 @@ def _gid_from_sorted(new_group: jax.Array, alive_sorted: jax.Array,
 # The multi-operand lax.sort above is O(log^2 n) merge passes over EVERY
 # operand (2K+2 arrays for K keys) — the dominant HBM traffic of group-by/
 # join programs. When every key is integer-typed (rank_key yields ints for
-# str/date/decimal too), the key tuple packs into one mixed-radix integer
-# using runtime min/max ranges:
-#   tier 1: domain product fits a static scatter table -> presence bitmap +
-#           cumsum gives gids in ONE linear pass (no sort at all);
-#   tier 2: domain fits the integer dtype -> single-key sort (one operand
-#           instead of 2K+2).
-# Both tiers order groups exactly like the sort-based path (value-ascending,
-# nulls last per key), so gids are bit-identical and the choice is purely a
-# performance decision, recorded/replayed by the executor (_decide_exact_lazy
-# — the same record-time eligibility pattern as the direct-address join).
+# str/date/decimal too) and the mixed-radix domain product fits the integer
+# dtype, the key tuple packs into ONE integer using runtime min/max ranges
+# and a single-key sort (one operand instead of 2K+2) replaces the generic
+# path. The packed tier orders groups exactly like the sort-based path
+# (value-ascending, nulls last per key), so gids are bit-identical and the
+# choice is purely a performance decision, recorded/replayed by the
+# executor (_decide_exact_lazy).
 # The reference gets this class of kernel from RAPIDS hash-groupby
 # (reference nds/power_run_gpu.template); here the TPU-friendly equivalent
 # is scatter+cumsum over a bounded domain.
@@ -255,12 +252,15 @@ def sort_specs(keys: list[SortKey]) -> tuple:
 
 # below this segment count, a vectorized (S, n) masked reduce beats the
 # scatter-add that segment_sum lowers to by ~600x on TPU (scatters
-# serialize; the broadcast+select fuses into the reduction)
+# serialize; the broadcast+select fuses into the reduction). COMPILED only:
+# the eager record pass would materialize the (S, n) intermediate (no
+# fusion outside jit), so concrete operands keep the O(n) segment path —
+# both forms compute identical values, so record/replay schedules agree.
 _MASKED_SEG_MAX = 64
 
 
 def _seg(data: jax.Array, gid: jax.Array, num_segments: int, op: str) -> jax.Array:
-    if num_segments <= _MASKED_SEG_MAX:
+    if num_segments <= _MASKED_SEG_MAX and isinstance(data, jax.core.Tracer):
         seg_ids = jnp.arange(num_segments, dtype=gid.dtype)
         mask = gid[None, :] == seg_ids[:, None]
         if op == "sum":

@@ -72,7 +72,14 @@ def factorize(key_cols: list[Column], pre_keys: list[np.ndarray] | None = None
 
 
 def take_with_null(col: Column, indices: np.ndarray) -> Column:
-    """Gather; negative indices produce NULL (outer-join fill)."""
+    """Gather; negative indices produce NULL (outer-join fill).
+
+    A zero-row source is legal (outer join against an empty build side:
+    every index is -1) — clip would index into nothing, so gather from a
+    one-null-row extension instead."""
+    if len(col.data) == 0 and len(indices):
+        col = Column(col.dtype, np.zeros(1, dtype=np.asarray(col.data).dtype),
+                     np.zeros(1, dtype=bool), col.dictionary)
     safe = np.where(indices >= 0, indices, 0)
     out = col.take(safe)
     miss = indices < 0

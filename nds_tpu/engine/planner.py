@@ -16,6 +16,7 @@ The reference delegates all of this to Spark Catalyst (nds_power.py:129
 from __future__ import annotations
 
 import datetime as _dt
+import os
 import re
 from dataclasses import dataclass, field, replace
 from typing import Callable, Optional
@@ -120,6 +121,9 @@ class Planner:
         node = self._plan_body(q.body, outer, ctes, q.order_by, q.limit)
         if top:
             node.cte_segments = list(self.cte_segments)
+            if not os.environ.get("NDS_TPU_NO_COLPRUNE"):
+                from .colprune import prune_plan
+                node = prune_plan(node)
         return node
 
     def _plan_cte(self, name: str, cq: A.Query, ctes: dict) -> P.PlanNode:

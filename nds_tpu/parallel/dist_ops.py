@@ -59,7 +59,8 @@ def _as_key_list(key) -> list[jax.Array]:
     return list(key) if isinstance(key, (list, tuple)) else [key]
 
 
-def repartition_by_key(mesh: Mesh, per_pair_capacity: int):
+def repartition_by_key(mesh: Mesh, per_pair_capacity: int,
+                       emit_key: bool = True):
     """Build a jittable all_to_all hash-repartition over `mesh`.
 
     Returned fn maps (columns, alive, key) — all row-sharded — to the same
@@ -68,6 +69,9 @@ def repartition_by_key(mesh: Mesh, per_pair_capacity: int):
     per_pair_capacity; callers must size capacity so this stays 0).
     `key` may be one array or a list of arrays (composite shuffle key: the
     hash mixes every column, the returned key is the first).
+    emit_key=False skips the separate exchanged key output (the alive mask
+    is returned in its slot) — join lowering already carries the key inside
+    `columns`, and the duplicate would cross the ICI once per run.
     """
     axis = mesh.axis_names[0]
     n_shards = mesh.devices.size
@@ -103,7 +107,7 @@ def repartition_by_key(mesh: Mesh, per_pair_capacity: int):
         out_cols = [place(c[order]) for c in cols]
         out_alive = jnp.zeros(n_shards * per_pair_capacity + 1, bool).at[
             flat].set(ok)[:n_shards * per_pair_capacity]
-        out_key = place(key[order])
+        out_key = place(key[order]) if emit_key else out_alive
         # exchange: block b of this shard -> shard b
         def exchange(x):
             blocks = x.reshape((n_shards, per_pair_capacity) + x.shape[1:])
@@ -111,7 +115,7 @@ def repartition_by_key(mesh: Mesh, per_pair_capacity: int):
                                   ).reshape((-1,) + x.shape[1:])
         out_cols = [exchange(c) for c in out_cols]
         out_alive = exchange(out_alive)
-        out_key = exchange(out_key)
+        out_key = exchange(out_key) if emit_key else out_alive
         overflow = lax.psum(overflow, axis)
         return out_cols, out_alive, out_key, overflow
 

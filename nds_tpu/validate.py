@@ -98,10 +98,14 @@ def iter_output_rows(files: list[str], ignore_ordering: bool,
                 run = os.path.join(tmp, f"run-{len(runs)}.parquet")
                 cols = list(zip(*rows)) if rows else [
                     [] for _ in schema.names]
+                # from_arrays, not pa.table(dict): output column names can
+                # legally repeat (two unaliased identical expressions) and a
+                # dict would silently drop all but one
                 pq.write_table(
-                    pa.table({n: pa.array(list(c), type=t.type)
-                              for n, t, c in zip(schema.names, schema,
-                                                 cols)}), run)
+                    pa.Table.from_arrays(
+                        [pa.array(list(c), type=t.type)
+                         for t, c in zip(schema, cols)],
+                        schema=schema), run)
                 runs.append(run)
 
         def run_iter(path):

@@ -1,0 +1,147 @@
+"""Disk-frugal SF100 warehouse builder: generate -> parquet -> delete, per chunk.
+
+The SF100 ladder step (BASELINE.md step 2: q1-q10 at SF100) needs a ~50 GB
+raw dataset on a host with less free disk than raw+parquet combined, so the
+whole-dataset datagen->transcode pipeline (nds_tpu.datagen + nds_tpu.transcode,
+reference nds/nds_gen_data.py -> nds/nds_transcode.py) is replaced here by a
+chunk loop: one generator chunk (a few million rows) is produced, transcoded
+into an appended warehouse parquet file, and its raw CSV deleted before the
+next chunk starts. Peak raw footprint is one chunk (~500 MB) instead of the
+full table.
+
+Resumable: per-table chunk progress persists in <root>/_build_state.json, so
+an interrupted multi-hour build continues where it stopped.
+
+Inventory is excluded by default (399M rows at SF100, needed by no query in
+the q1-q10 ladder step); pass --with_inventory for the full set.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from nds_tpu.datagen import check_build              # noqa: E402
+from nds_tpu.schema import get_schemas               # noqa: E402
+from nds_tpu.transcode import load_csv               # noqa: E402
+from nds_tpu.warehouse import Warehouse              # noqa: E402
+
+# SF1 row counts (generator's own sizing model) used only to pick a chunk
+# fan-out that lands ~CHUNK_ROWS rows per generated file
+SF1_ROWS = {
+    "store_sales": 2_880_000, "store_returns": 288_000,
+    "catalog_sales": 1_440_000, "catalog_returns": 144_000,
+    "web_sales": 720_000, "web_returns": 72_000,
+    "inventory": 11_745_000, "customer": 100_000,
+    "customer_address": 50_000, "customer_demographics": 1_920_800,
+}
+CHUNK_ROWS = 4_000_000
+
+SMALL_TABLES = [
+    "call_center", "catalog_page", "date_dim", "household_demographics",
+    "income_band", "item", "promotion", "reason", "ship_mode", "store",
+    "time_dim", "warehouse", "web_page", "web_site",
+]
+MEDIUM_TABLES = ["customer", "customer_address", "customer_demographics"]
+FACT_TABLES = ["store_returns", "catalog_returns", "web_returns",
+               "web_sales", "catalog_sales", "store_sales"]
+
+
+def _parallel_for(table: str, scale: float) -> int:
+    rows = SF1_ROWS.get(table, 0) * scale
+    return max(1, int(round(rows / CHUNK_ROWS))) if rows else 1
+
+
+def _gen_chunk(binary: str, work: str, table: str, scale: float,
+               parallel: int, child: int) -> str:
+    os.makedirs(work, exist_ok=True)
+    subprocess.run([binary, "-scale", str(scale), "-dir", work,
+                    "-parallel", str(parallel), "-child", str(child),
+                    "-table", table], check=True)
+    name = (f"{table}_{child}_{parallel}.dat" if parallel > 1
+            else f"{table}.dat")
+    return os.path.join(work, name)
+
+
+def build(root: str, scale: float, tables: list[str],
+          use_decimal: bool = True) -> None:
+    binary = check_build()
+    wh = Warehouse(root)
+    state_path = os.path.join(root, "_build_state.json")
+    state: dict = {}
+    if os.path.exists(state_path):
+        with open(state_path) as f:
+            state = json.load(f)
+
+    def save_state():
+        tmp = state_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f, indent=1)
+        os.replace(tmp, state_path)
+
+    schemas = dict(get_schemas(use_decimal))
+    work = os.path.join(root, "_raw_chunk_")
+    for table in tables:
+        parallel = _parallel_for(table, scale)
+        st = state.get(table, {"chunk": 0, "version": 0})
+        wt = wh.table(table)
+        # crash-between-insert-and-save reconcile: every non-empty chunk
+        # commits exactly one snapshot, so a manifest ahead of the recorded
+        # version means those chunks landed but were not checkpointed —
+        # roll the chunk counter forward instead of re-inserting them
+        cur_version = len(wt._load())
+        if cur_version > st["version"]:
+            st["chunk"] += cur_version - st["version"]
+            st["version"] = cur_version
+            state[table] = st
+            save_state()
+        done = st["chunk"]
+        if done >= parallel:
+            print(f"[skip] {table}: complete ({parallel} chunks)", flush=True)
+            continue
+        sch = schemas[table].arrow_schema(use_decimal=use_decimal)
+        for child in range(done + 1, parallel + 1):
+            path = _gen_chunk(binary, work, table, scale, parallel, child)
+            if os.path.getsize(path) > 0:
+                t = load_csv(path, sch)
+                if wt.exists():
+                    wt.insert(t, partition=False)
+                else:
+                    wt.create(t, partition=False)
+                rows = t.num_rows
+            else:
+                rows = 0
+            os.remove(path)
+            state[table] = {"chunk": child, "version": len(wt._load())}
+            save_state()
+            print(f"[{table}] chunk {child}/{parallel}: {rows} rows",
+                  flush=True)
+    shutil.rmtree(work, ignore_errors=True)
+    print("SF%s warehouse complete at %s" % (scale, root), flush=True)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="build_sf100")
+    p.add_argument("--root", default=os.path.join(REPO, ".bench_data",
+                                                  "sf100_wh"))
+    p.add_argument("--scale", type=float, default=100.0)
+    p.add_argument("--tables", default=None,
+                   help="comma-separated subset (default: dims+facts)")
+    p.add_argument("--with_inventory", action="store_true")
+    p.add_argument("--no_decimal", action="store_true")
+    a = p.parse_args(argv)
+    tables = (a.tables.split(",") if a.tables else
+              SMALL_TABLES + MEDIUM_TABLES + FACT_TABLES +
+              (["inventory"] if a.with_inventory else []))
+    build(a.root, a.scale, tables, use_decimal=not a.no_decimal)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

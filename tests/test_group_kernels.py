@@ -103,6 +103,10 @@ def _big_session(n=20000):
 BIG_CORPUS = [
     # grouped agg (tier 1), incl. strings as keys (rank-LUT codes)
     "SELECT cat, day, COUNT(*), SUM(qty) FROM fact GROUP BY cat, day",
+    # FLOAT group key: legal SQL with no iinfo range — must take the
+    # generic sort tier, not crash the pack probe (sorted-agg gate)
+    "SELECT price, COUNT(*) FROM fact GROUP BY price "
+    "ORDER BY 2 DESC, 1 LIMIT 5",
     # wide key domain -> packed sort tier
     "SELECT wide, COUNT(*) FROM fact GROUP BY wide ORDER BY 2 DESC LIMIT 10",
     # rollup: per-grouping-set tiers

@@ -168,8 +168,9 @@ class _Pruner:
             return
         if isinstance(node, SetOpNode):
             if node.op == "union" and node.all:
-                self.collect(node.left, set(need))
-                self.collect(node.right, set(need))
+                req = set(need) or {0}   # must mirror _keep's normalization
+                self.collect(node.left, req)
+                self.collect(node.right, req)
             else:  # row-equality semantics: every column participates
                 self.collect(node.left, set(range(_width(node.left))))
                 self.collect(node.right, set(range(_width(node.right))))

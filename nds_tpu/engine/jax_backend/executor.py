@@ -698,6 +698,29 @@ class JaxExecutor:
                           else jnp.zeros((), _I32))
         return v
 
+    def _decide_branch(self, value: bool) -> bool:
+        """Record/replay a CAPACITY-DEPENDENT structural branch.
+
+        Capacities drift between record and replay by design (streaming
+        inflates every cap decision to the morsel bound, inflate_schedule),
+        so a branch gated on `capacity >= X` must take the RECORDED side
+        under replay — both sides are semantically correct, and replaying
+        the record-time choice keeps the decision schedule aligned. The
+        check is a constant equal to the recorded value (trivially passing:
+        the branch is a performance choice, not a data property)."""
+        rec = self._rec
+        if rec is None:
+            return value
+        if rec.mode == "record":
+            rec.decisions.append(("exact", int(value)))
+            return value
+        kind, v = rec.decisions[rec.idx]
+        rec.idx += 1
+        if kind != "exact":
+            raise NotJittable("decision kind drift (branch)")
+        rec.checks.append(jnp.full((), int(v), _I32))
+        return bool(v)
+
     # -- helpers -------------------------------------------------------------
     def _eval(self, expr: BExpr, table: DTable) -> DCol:
         return jexprs.evaluate(expr, table, subquery_eval=self._ectx())
@@ -722,11 +745,15 @@ class JaxExecutor:
         kernel (pack ranges are data-dependent reductions that would force
         GSPMD gathers)."""
         n = int(alive.shape[0])
-        if (self._mesh is None and key_data and n >= (1 << 13)
+        if (self._mesh is None and key_data
                 and all(jnp.issubdtype(d.dtype, jnp.integer)
                         for d in key_data)):
-            if self._decide_exact_lazy(
-                    lambda: kernels.group_tier(key_data, key_valid, alive)):
+            # the size cutoff is capacity-derived: replay must follow the
+            # record-time branch (streaming inflates capacities)
+            if self._decide_branch(n >= (1 << 13)) and \
+                    self._decide_exact_lazy(
+                        lambda: kernels.group_tier(key_data, key_valid,
+                                                   alive)):
                 return kernels.dense_rank_packsort(key_data, key_valid, alive)
         return kernels.dense_rank(key_data, key_valid, alive)
 
@@ -1019,7 +1046,7 @@ class JaxExecutor:
         by every rollup prefix level, within-group scans instead of the
         serialized segment scatters, S-sized gathers for output assembly.
         Single-device only (the mesh path has its own shard-local plan)."""
-        if self._mesh is not None or child.capacity < (1 << 13):
+        if self._mesh is not None:
             return False
         if not node.group_exprs:
             return False          # global aggregate: masked reduces suffice
@@ -1030,7 +1057,10 @@ class JaxExecutor:
                 return False
             if s.arg is not None and s.arg.dtype == "str":
                 return False
-        return True
+        # capacity cutoff LAST (after the static gates) so the recorded
+        # branch sits at a deterministic schedule position; replay follows
+        # the record-time choice (streaming inflates capacities)
+        return self._decide_branch(child.capacity >= (1 << 13))
 
     def _aggregate_sorted(self, node: AggregateNode, child: DTable,
                           grouping_sets: list) -> DTable:
@@ -1690,14 +1720,18 @@ class JaxExecutor:
         mesh = self._mesh
         nsh = mesh.devices.size
         lcap, rcap = left.capacity, right.capacity
-        if min(lcap, rcap) < max(self._shard_min_rows, nsh) \
-                or lcap % nsh or rcap % nsh:
-            return None
         if any(c.parts is not None for c in left.cols + right.cols):
             return None
         pairs = [_joinable_pair(a, b) for a, b in zip(lkeys, rkeys)]
         if not pairs or any(not jnp.issubdtype(a.dtype, jnp.integer)
                             for a, _ in pairs):
+            return None
+        # capacity gate AFTER the static gates: the recorded branch must sit
+        # at a deterministic schedule position, and replay follows the
+        # record-time choice (capacities drift under streaming inflation)
+        if not self._decide_branch(
+                min(lcap, rcap) >= max(self._shard_min_rows, nsh)
+                and lcap % nsh == 0 and rcap % nsh == 0):
             return None
         lkd = [a for a, _ in pairs]
         rkd = [b for _, b in pairs]

@@ -78,6 +78,15 @@ def build(root: str, scale: float, tables: list[str],
     if os.path.exists(state_path):
         with open(state_path) as f:
             state = json.load(f)
+    # chunk counts derive from (scale, CHUNK_ROWS): a state written under
+    # different build params must not be resumed into this chunking
+    params = {"scale": scale, "chunk_rows": CHUNK_ROWS,
+              "use_decimal": use_decimal}
+    if state.get("_params", params) != params:
+        raise SystemExit(
+            f"{state_path} was written by a build with params "
+            f"{state['_params']} != {params}; use a fresh --root")
+    state["_params"] = params
 
     def save_state():
         tmp = state_path + ".tmp"
@@ -91,11 +100,17 @@ def build(root: str, scale: float, tables: list[str],
         parallel = _parallel_for(table, scale)
         st = state.get(table, {"chunk": 0, "version": 0})
         wt = wh.table(table)
+        cur_version = len(wt._load())
+        if table not in state and cur_version:
+            raise SystemExit(
+                f"table {table!r} already has {cur_version} snapshot(s) in "
+                f"{root} but no build state — it was not produced by this "
+                f"script's chunk loop; use a fresh --root or --tables "
+                f"without it")
         # crash-between-insert-and-save reconcile: every non-empty chunk
         # commits exactly one snapshot, so a manifest ahead of the recorded
         # version means those chunks landed but were not checkpointed —
         # roll the chunk counter forward instead of re-inserting them
-        cur_version = len(wt._load())
         if cur_version > st["version"]:
             st["chunk"] += cur_version - st["version"]
             st["version"] = cur_version

@@ -109,6 +109,21 @@ def test_pruned_equals_unpruned(data_dir, number):
         os.environ.pop("NDS_TPU_NO_COLPRUNE", None)
 
 
+def test_union_all_under_countstar(data_dir):
+    """A set-op whose output is entirely unneeded (COUNT(*) above) must
+    normalize like _keep does — regression for a KeyError during rebuild
+    when a branch pruned away column 0."""
+    s = _session(data_dir)
+    out = s.sql(
+        "SELECT COUNT(*) AS n FROM ("
+        " (SELECT i_item_sk AS a, i_manufact_id AS b FROM item"
+        "  UNION ALL SELECT i_item_sk, i_manufact_id FROM item"
+        "  ORDER BY 2 LIMIT 3)"
+        " UNION ALL SELECT i_item_sk, i_manufact_id FROM item) x",
+        backend="numpy")
+    assert out.num_rows == 1
+
+
 def test_empty_build_side_outer_join(data_dir):
     """take_with_null against a zero-row build side (q41 at tiny SF)."""
     s = _session(data_dir)

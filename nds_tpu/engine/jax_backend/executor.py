@@ -1712,9 +1712,11 @@ class JaxExecutor:
         blocks), then join shard-locally — the fact sides never gather
         (Spark shuffle join; SURVEY.md §2 parallelism table last row).
         GSPMD's fallback for the generic sort-based join pulls fact-sized
-        buffers to every device. Eligibility is static, so record and
-        replay take the same branch; capacities (max hash-block size, max
-        per-shard match count) are recorded schedule decisions."""
+        buffers to every device. Column/dtype eligibility is static; the
+        capacity gate is a RECORDED branch (replay follows the record-time
+        choice — capacities drift under streaming inflation), and the max
+        hash-block / per-shard match counts are recorded schedule
+        decisions."""
         from ...parallel import dist_ops
 
         mesh = self._mesh

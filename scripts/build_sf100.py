@@ -98,15 +98,21 @@ def build(root: str, scale: float, tables: list[str],
     work = os.path.join(root, "_raw_chunk_")
     for table in tables:
         parallel = _parallel_for(table, scale)
-        st = state.get(table, {"chunk": 0, "version": 0})
         wt = wh.table(table)
         cur_version = len(wt._load())
-        if table not in state and cur_version:
-            raise SystemExit(
-                f"table {table!r} already has {cur_version} snapshot(s) in "
-                f"{root} but no build state — it was not produced by this "
-                f"script's chunk loop; use a fresh --root or --tables "
-                f"without it")
+        if table not in state:
+            if cur_version:
+                raise SystemExit(
+                    f"table {table!r} already has {cur_version} snapshot(s) "
+                    f"in {root} but no build state — it was not produced by "
+                    f"this script's chunk loop; use a fresh --root or "
+                    f"--tables without it")
+            # register BEFORE the first insert: a crash between chunk 1's
+            # commit and its checkpoint must land in the reconcile below,
+            # not in the foreign-snapshot guard above
+            state[table] = {"chunk": 0, "version": 0}
+            save_state()
+        st = state[table]
         # crash-between-insert-and-save reconcile: every non-empty chunk
         # commits exactly one snapshot, so a manifest ahead of the recorded
         # version means those chunks landed but were not checkpointed —

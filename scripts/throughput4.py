@@ -1,0 +1,84 @@
+"""Sq=4 throughput-concurrency measurement on one chip (VERDICT r3 #4).
+
+Methodology (matches the round-3 2-stream measurement in PERF.md): one
+process, one session per stream, every (stream, query) pre-run to the
+compiled steady state, then (a) the 4 streams run back-to-back serially,
+(b) the 4 streams run concurrently on 4 threads sharing the chip.
+Concurrency efficiency = serial_total / concurrent_elapsed (2.0 means two
+chips' worth of work in one chip's wall-clock; 4.0 is the ceiling).
+
+The reference's throughput test is N full Spark apps via xargs -P
+(nds/nds-throughput) arbitrated by the cluster scheduler; here N sessions
+multiplex one TPU via XLA async dispatch, so one stream's host phases
+overlap another's device work.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+QUERIES = os.environ.get("T4_QUERIES",
+                         "query1,query2,query3,query4,query5,query6,"
+                         "query7,query8,query9,query10").split(",")
+WH = os.environ.get("T4_WAREHOUSE", ".bench_data/sf1_wh")
+STREAMS = os.environ.get("T4_STREAMS", ".bench_data/sf1_streams5")
+
+
+def main() -> int:
+    from nds_tpu.config import EngineConfig, apply_decimal, \
+        maybe_enable_compile_cache
+    maybe_enable_compile_cache()
+    cfg0 = EngineConfig()
+    apply_decimal(cfg0, "i64")
+
+    from nds_tpu.engine import Session
+    from nds_tpu.power import gen_sql_from_stream, setup_tables
+
+    sessions = []
+    plans: list[list[tuple[str, str]]] = []
+    for sid in (1, 2, 3, 4):
+        cfg = EngineConfig(decimal_physical="i64")
+        s = Session(cfg)
+        setup_tables(s, WH, "parquet")
+        qd = gen_sql_from_stream(
+            open(os.path.join(STREAMS, f"query_{sid}.sql")).read())
+        work = [(n, sql) for n, sql in qd.items()
+                if n in QUERIES or n.rsplit("_part", 1)[0] in QUERIES]
+        sessions.append(s)
+        plans.append(work)
+
+    def run_stream(i: int) -> float:
+        t0 = time.perf_counter()
+        s = sessions[i]
+        for name, sql in plans[i]:
+            for stmt in [x for x in sql.split(";") if x.strip()]:
+                s.sql(stmt, backend="jax")
+        return time.perf_counter() - t0
+
+    # steady state: two pre-runs per stream (record+compile, then warm)
+    for r in range(2):
+        for i in range(4):
+            dt = run_stream(i)
+            print(f"warm{r} stream{i + 1}: {dt:.2f}s", flush=True)
+
+    serial = [run_stream(i) for i in range(4)]
+    print("serial per-stream s:", [round(x, 2) for x in serial], flush=True)
+
+    t0 = time.perf_counter()
+    with ThreadPoolExecutor(max_workers=4) as pool:
+        list(pool.map(run_stream, range(4)))
+    concurrent = time.perf_counter() - t0
+
+    eff = sum(serial) / concurrent
+    print(f"serial_total={sum(serial):.2f}s concurrent={concurrent:.2f}s "
+          f"efficiency={eff:.2f}x", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -117,6 +117,37 @@ def _neg(expr: BCall, table: Table, sq) -> Column:
     return Column.from_values(a.dtype, -np.asarray(a.data), a.valid)
 
 
+def _ratdiv(which: str):
+    """Exact rational order key (planner._exact_rational_keys): "hi" =
+    floor(p/q), "lo" = 56 binary fraction digits, matching the jax backend's
+    jexprs._ratdiv bit-for-bit so rank ties agree across backends."""
+    def run(expr: BCall, table: Table, sq) -> Column:
+        a, b = _eval_args(expr, table, sq)
+        sa = dec_scale(a.dtype) if is_dec(a.dtype) else 0
+        sb = dec_scale(b.dtype) if is_dec(b.dtype) else 0
+        p = np.asarray(a.data, dtype=np.int64) * (10 ** sb)
+        q = np.asarray(b.data, dtype=np.int64) * (10 ** sa)
+        neg = q < 0
+        p = np.where(neg, -p, p)
+        q = np.where(neg, -q, q)
+        bv = _both_valid(a, b)
+        valid = (np.ones(len(p), bool) if bv is None else np.asarray(bv)) \
+            & (q != 0)
+        qs = np.where(q == 0, 1, q)
+        hi = p // qs
+        if which == "hi":
+            return Column.from_values("int", np.where(valid, hi, 0), valid)
+        r = p - hi * qs
+        lo = np.zeros_like(r)
+        for _ in range(8):
+            r = r << 7
+            d = r // qs
+            r = r - d * qs
+            lo = (lo << 7) | d
+        return Column.from_values("int", np.where(valid, lo, 0), valid)
+    return run
+
+
 # -- comparisons ------------------------------------------------------------
 
 _CMP_FN = {
@@ -512,6 +543,7 @@ def _nullif(expr: BCall, table: Table, sq) -> Column:
 _HANDLERS = {
     "add": _arith("add"), "sub": _arith("sub"), "mul": _arith("mul"),
     "div": _arith("div"), "mod": _arith("mod"), "neg": _neg,
+    "ratdiv_hi": _ratdiv("hi"), "ratdiv_lo": _ratdiv("lo"),
     "eq": _compare("eq"), "ne": _compare("ne"), "lt": _compare("lt"),
     "le": _compare("le"), "gt": _compare("gt"), "ge": _compare("ge"),
     "and": _and, "or": _or, "not": _not,

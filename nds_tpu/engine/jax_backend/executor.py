@@ -22,6 +22,7 @@ backend for that node only (eager mode; such plans are never compiled).
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Callable, Optional
 
 import jax
@@ -69,6 +70,26 @@ class _Recorder:
         self.checks: list[jax.Array] = []   # traced actuals (replay only)
 
 
+# Cross-stream/-session compiled-program registry (VERDICT r4 #4): stream
+# variants of one template parameterize to THE SAME plan (parameterize_plan),
+# so the first stream's recorded schedule + compiled program can serve every
+# later stream with different parameter VALUES — no re-record, no re-trace,
+# no compile. Keyed by a structural plan fingerprint; capacity drift between
+# streams is caught by _verify_schedule (caps are <=-checked) and handled by
+# re-recording with per-slot max-merged caps, so the program converges to a
+# shape serving all streams. Exact-decision drift marks the entry volatile
+# (per-stream programs, the pre-registry behavior). The reference's analog
+# is Spark reusing planned queries across streams (nds/nds_power.py:124-134).
+_SHARED_PROGRAMS: dict = {}
+_SHARED_LOCK = threading.Lock()
+
+
+def clear_shared_programs() -> None:
+    """Test hook: drop all cross-session shared programs."""
+    with _SHARED_LOCK:
+        _SHARED_PROGRAMS.clear()
+
+
 def _verify_schedule(decisions: list, checks_host: list) -> None:
     for (kind, planned), actual in zip(decisions, checks_host):
         a = int(actual)
@@ -100,6 +121,7 @@ class CompiledQuery:
         self.param_dtypes = param_dtypes
         self.shard_min_rows = shard_min_rows
         self._fn = None
+        self._aot = None     # AOT executable from precompile()
 
     def _trace(self, scan_tuple: tuple, params: tuple):
         scans = dict(zip(self.scan_keys, scan_tuple))
@@ -124,6 +146,24 @@ class CompiledQuery:
                        for v, d in zip(values, self.param_dtypes))
         return scan_tuple, params
 
+    def precompile(self, scan_specs: tuple, stats: Optional[dict] = None):
+        """Trace + compile ahead of execution from abstract arg specs
+        (jax.ShapeDtypeStruct trees mirroring the scan tables) WITHOUT
+        uploading data. Raises the same _NOJIT_ERRORS a traced run would.
+        The resulting AOT executable serves run() directly; compile RPCs
+        through the tunnel parallelize, so callers fan precompile() calls
+        out over a thread pool (one compile per segment/query at once
+        instead of serial-at-first-execution)."""
+        import time as _time
+        if self._fn is None:
+            self._fn = jax.jit(self._trace)
+        params = tuple(jax.ShapeDtypeStruct((), phys_dtype(d))
+                       for d in self.param_dtypes)
+        t0 = _time.perf_counter()
+        self._aot = self._fn.lower(scan_specs, params).compile()
+        if stats is not None:
+            stats["precompile_s"] = round(_time.perf_counter() - t0, 3)
+
     def run(self, scans: dict, values: tuple = (),
             stats: Optional[dict] = None,
             keep_device: bool = False) -> DTable:
@@ -133,7 +173,19 @@ class CompiledQuery:
         if first:
             self._fn = jax.jit(self._trace)
         t1 = _time.perf_counter()
-        out, checks = self._fn(*self._args(scans, values))
+        if self._aot is not None:
+            try:
+                out, checks = self._aot(*self._args(scans, values))
+            except (TypeError, ValueError):
+                # spec/arg mismatch (shape or placement drift): fall back to
+                # the jit path once — the persistent compile cache still
+                # serves the binary if the lowering matches. Runtime errors
+                # (JaxRuntimeError: OOM, tunnel drops) propagate to the
+                # caller's retry/rt_failures machinery instead.
+                self._aot = None
+                out, checks = self._fn(*self._args(scans, values))
+        else:
+            out, checks = self._fn(*self._args(scans, values))
         # ONE device_get for result + checks: tunneled platforms charge a
         # fixed RTT per transfer, so piecemeal np.asarray would dominate.
         # keep_device (segment outputs feeding downstream programs): only
@@ -208,6 +260,9 @@ class JaxExecutor:
         # the end). Evicting frees the arrays for XLA to reuse.
         self._scan_budget = scan_budget_bytes
         self._resident: dict[str, int] = {}
+        # fingerprint whose shared program just ReplayMismatched here: the
+        # post-mismatch re-record must not re-adopt it (see _adopt_shared)
+        self._fp_block: Optional[str] = None
         # Eager (record / fallback) execution runs on the host CPU backend
         # when the default device is an accelerator: per-op dispatch latency
         # through a device tunnel is catastrophic, and the record pass only
@@ -266,6 +321,17 @@ class JaxExecutor:
         seg_ms = 0.0
         segs_run = 0
         out = None
+        # second sighting of a multi-unit query: every unit has a recorded
+        # schedule but no program yet — compile them CONCURRENTLY before
+        # executing (q22's 7 rollup segments compile in max() not sum())
+        if key is not None and self._jit_plans:
+            unit_keys = [((key, "root") if sk is None else (key, sk))
+                         for sk, _ in units]
+            if any(self._plans.get(uk, {}).get("decisions") is not None
+                   and self._plans[uk].get("cq") is None
+                   and not self._plans[uk].get("nojit")
+                   for uk in unit_keys):
+                self.precompile_parallel(keys=set(unit_keys))
         # pin this query's segments: LRU pressure from binding segment N
         # must never evict segment M still needed by a later unit
         self._pinned_segments = {sk for sk, _ in units if sk is not None}
@@ -431,7 +497,17 @@ class JaxExecutor:
                     out = self._run_compiled(ent["cq"], ent, keep_device)
                     ent["rt_failures"] = 0
                     return out
+                except _NOJIT_ERRORS as e:
+                    # reachable when precompile_parallel installed the cq
+                    # from specs and the real args re-trace differently
+                    ent["cq"] = None
+                    ent["nojit"] = True
+                    ent["nojit_reason"] = f"{type(e).__name__}: {e}"
+                    self.last_stats["mode"] = "eager"
+                    self.last_stats["nojit_reason"] = ent["nojit_reason"]
+                    return self._eager_ent(ent)
                 except ReplayMismatch:
+                    self._fp_block = ent.get("fp")
                     self._plans.pop(key, None)
                     ent = None
                 except jax.errors.JaxRuntimeError as e:
@@ -458,6 +534,7 @@ class JaxExecutor:
                     out = self._run_compiled(cq, ent, keep_device)
                     ent["cq"] = cq
                     ent["rt_failures"] = 0
+                    self._publish_cq(ent)
                     return out
                 except _NOJIT_ERRORS as e:
                     ent["nojit"] = True
@@ -466,6 +543,7 @@ class JaxExecutor:
                     self.last_stats["nojit_reason"] = ent["nojit_reason"]
                     return self._eager_ent(ent)
                 except ReplayMismatch:
+                    self._fp_block = ent.get("fp")
                     self._plans.pop(key, None)
                     ent = None
                 except jax.errors.JaxRuntimeError as e:
@@ -479,19 +557,192 @@ class JaxExecutor:
                     return self._eager_ent(ent)
         # first sighting (or invalidated): eager run, recording the schedule
         plan = plan_factory()
+        fp = None
         if key is not None and self._jit_plans:
             pplan, pvalues, pdtypes = parameterize_plan(plan)
+            fp = self._shared_fp(pplan)
+            if self._adopt_shared(key, fp, tuple(pvalues), tuple(pdtypes)):
+                self.last_stats["mode"] = "adopted"
+                return self._run_unit(key, plan, keep_device)
         else:       # uncached one-shot: skip the rewrite, nothing reuses it
             pplan, pvalues, pdtypes = plan, [], []
         self.last_stats["mode"] = "record"
         out, decisions, scan_keys = self.record_plan(pplan, tuple(pvalues))
         if key is not None and self._jit_plans:
-            self._plans[key] = {
+            ent = {
                 "plan": pplan, "decisions": decisions,
                 "scan_keys": scan_keys,
                 "params": tuple(pvalues), "param_dtypes": tuple(pdtypes),
-                "cq": None, "nojit": len(self.fallback_nodes) > fb0}
+                "cq": None, "nojit": len(self.fallback_nodes) > fb0,
+                "fp": fp}
+            self._publish_recorded(ent)
+            self._plans[key] = ent
+            self._fp_block = None
         return out
+
+    # -- cross-stream program sharing ----------------------------------------
+    def _shared_fp(self, pplan) -> Optional[str]:
+        """Registry key for a parameterized unit plan, or None when sharing
+        is off (mesh runs lower against sharded args; jit disabled)."""
+        if self._mesh is not None or not self._jit_plans:
+            return None
+        import hashlib
+        x64 = jax.config.read("jax_enable_x64")
+        body = _plan_fingerprint(pplan)
+        return hashlib.sha1(
+            f"{body}|x64={x64}|smr={self._shard_min_rows}".encode()
+        ).hexdigest()
+
+    def _adopt_shared(self, key, fp, pvalues: tuple, pdtypes: tuple) -> bool:
+        """Install another stream's entry (schedule + program) for `key`."""
+        if fp is None or fp == getattr(self, "_fp_block", None):
+            return False
+        with _SHARED_LOCK:
+            sh = _SHARED_PROGRAMS.get(fp)
+            if sh is None or sh.get("volatile") or sh.get("nojit") \
+                    or sh.get("param_dtypes") != pdtypes:
+                return False
+            ent = {"plan": sh["plan"], "decisions": list(sh["decisions"]),
+                   "scan_keys": sh["scan_keys"], "params": pvalues,
+                   "param_dtypes": pdtypes, "cq": sh.get("cq"),
+                   "nojit": False, "fp": fp}
+            scan_meta = dict(sh["scan_meta"])
+        for k, v in scan_meta.items():
+            self._scan_meta.setdefault(k, v)
+        self._plans[key] = ent
+        return True
+
+    def _publish_recorded(self, ent) -> None:
+        """Publish a freshly recorded schedule; cap-merge with any previous
+        stream's so the eventual program serves every stream seen so far."""
+        fp = ent.get("fp")
+        if fp is None:
+            return
+        entry = {"plan": ent["plan"], "decisions": list(ent["decisions"]),
+                 "scan_keys": ent["scan_keys"],
+                 "param_dtypes": ent.get("param_dtypes", ()),
+                 "scan_meta": {k: self._scan_meta[k]
+                               for k in ent["scan_keys"]
+                               if k in self._scan_meta},
+                 "cq": None, "nojit": ent.get("nojit", False)}
+        with _SHARED_LOCK:
+            old = _SHARED_PROGRAMS.get(fp)
+            if old is not None and old.get("volatile"):
+                return   # proven stream-dependent: stays per-stream forever
+            if old is not None \
+                    and len(old["decisions"]) == len(entry["decisions"]):
+                pairs = list(zip(old["decisions"], entry["decisions"]))
+                if any(k1 != k2 for (k1, _), (k2, _) in pairs):
+                    entry["volatile"] = True
+                elif any(k == "exact" and v1 != v2
+                         for (k, v1), (_, v2) in pairs):
+                    # structure differs per stream: sharing would replay the
+                    # wrong branch — revert to per-stream programs
+                    entry["volatile"] = True
+                else:
+                    merged = [(k, max(v1, v2) if k == "cap" else v1)
+                              for (k, v1), (_, v2) in pairs]
+                    if merged == old["decisions"] and old.get("cq") is not None:
+                        ent["decisions"] = list(merged)
+                        ent["cq"] = old["cq"]
+                        return          # old program already covers this
+                    entry["decisions"] = merged
+                    ent["decisions"] = list(merged)
+            elif old is not None and len(old["decisions"]) != \
+                    len(entry["decisions"]):
+                entry["volatile"] = True
+            _SHARED_PROGRAMS[fp] = entry
+
+    def _publish_cq(self, ent) -> None:
+        """Publish a compiled program for adoption by other streams."""
+        fp = ent.get("fp")
+        if fp is None or ent.get("cq") is None:
+            return
+        with _SHARED_LOCK:
+            sh = _SHARED_PROGRAMS.get(fp)
+            if sh is not None and not sh.get("volatile") \
+                    and sh.get("cq") is None \
+                    and sh["decisions"] == ent["decisions"]:
+                sh["cq"] = ent["cq"]
+
+    def _scan_specs(self, ent) -> Optional[tuple]:
+        """jax.ShapeDtypeStruct tree mirroring _scans_for(ent) WITHOUT
+        uploading anything: shapes come from whichever side already holds
+        the table (exec cache, record cache, or segment-output cache).
+        None when some scan's shape is not yet known (never recorded)."""
+        specs = []
+        for k in ent["scan_keys"]:
+            src = self._scan_cache.get(k)
+            if src is None:
+                src = self._scan_cache_rec.get(k)
+            if src is None:
+                return None
+            specs.append(jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), src))
+        return tuple(specs)
+
+    def precompile_parallel(self, keys=None, max_workers: Optional[int] = None
+                            ) -> dict:
+        """Compile every recorded-but-uncompiled plan entry concurrently.
+
+        The remote-compile tunnel serves parallel compile RPCs (measured
+        ~3.4x with 4 threads), so a cold stream's programs compile in
+        max(single) instead of sum(serial) — the reference pays ~ms of
+        Spark planning per query (nds/nds_power.py:124-134) where this
+        engine pays XLA compiles; this is the batching lever that makes a
+        cold pass wall-clock comparable. Single-device only: mesh runs
+        lower against sharded committed args, which ShapeDtypeStructs here
+        do not carry.
+
+        keys: restrict to these plan-entry keys (None = all cached).
+        Returns {key: "compiled"|"nojit"|"skipped"} for observability.
+        """
+        import os as _os
+        from concurrent.futures import ThreadPoolExecutor
+
+        if self._mesh is not None:
+            return {}
+        todo = []
+        for k, ent in list(self._plans.items()):
+            if not isinstance(ent, dict) or "decisions" not in ent:
+                continue
+            if keys is not None and k not in keys:
+                continue
+            if ent.get("cq") is not None or ent.get("nojit"):
+                continue
+            specs = self._scan_specs(ent)
+            if specs is None:
+                continue
+            cq = CompiledQuery(ent["plan"], ent["decisions"],
+                               ent["scan_keys"], mesh=self._mesh,
+                               param_dtypes=ent.get("param_dtypes", ()),
+                               shard_min_rows=self._shard_min_rows)
+            todo.append((k, ent, cq, specs))
+        if not todo:
+            return {}
+        workers = max_workers or int(_os.environ.get(
+            "NDS_TPU_COMPILE_WORKERS", "8"))
+        results: dict = {}
+
+        def one(item):
+            k, ent, cq, specs = item
+            try:
+                cq.precompile(specs)
+                return k, ent, cq, "compiled"
+            except _NOJIT_ERRORS as e:
+                ent["nojit"] = True
+                ent["nojit_reason"] = f"{type(e).__name__}: {e}"
+                return k, ent, None, "nojit"
+            except Exception as e:          # infra hiccup: leave lazy path
+                return k, ent, None, f"skipped: {type(e).__name__}"
+
+        with ThreadPoolExecutor(min(workers, len(todo))) as pool:
+            for k, ent, cq, status in pool.map(one, todo):
+                if cq is not None:
+                    ent["cq"] = cq
+                    self._publish_cq(ent)
+                results[k] = status
+        return results
 
     def compiled_hlo(self, key) -> Optional[str]:
         """Optimized (post-GSPMD) HLO of the steady-state program for `key`
@@ -1730,11 +1981,24 @@ class JaxExecutor:
             return None
         # capacity gate AFTER the static gates: the recorded branch must sit
         # at a deterministic schedule position, and replay follows the
-        # record-time choice (capacities drift under streaming inflation)
+        # record-time choice (capacities drift under streaming inflation).
+        # Only the min-rows threshold is a pure perf choice; divisibility is
+        # a STRUCTURAL precondition (shard_rows = cap // nsh truncates rows
+        # otherwise), so it is re-verified against the replay-time
+        # capacities — drift to a non-divisible cap forces a re-record
+        # instead of silently dropping trailing rows.
         if not self._decide_branch(
                 min(lcap, rcap) >= max(self._shard_min_rows, nsh)
                 and lcap % nsh == 0 and rcap % nsh == 0):
             return None
+        if lcap % nsh != 0 or rcap % nsh != 0:
+            # ReplayMismatch (not NotJittable): the caller routes it to a
+            # fresh record, which re-evaluates the gate against the drifted
+            # capacities and takes the generic join — NotJittable would mark
+            # the entry permanently eager instead
+            raise ReplayMismatch(
+                f"shuffle-join capacities ({lcap}, {rcap}) drifted off the "
+                f"shard-count multiple ({nsh}); re-record required")
         lkd = [a for a, _ in pairs]
         rkd = [b for _, b in pairs]
         l_ok = left.alive & lvalid

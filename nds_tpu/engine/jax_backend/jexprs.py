@@ -176,6 +176,48 @@ def _neg(expr: BCall, table: DTable, sq) -> DCol:
     return DCol(a.dtype, -a.data, a.valid)
 
 
+def _ratdiv(which: str):
+    """Exact rational order key for num/den (planner._exact_rational_keys):
+    "hi" = floor(p/q), "lo" = binary fraction digits, both via exact integer
+    divmod (device int64 // and % ARE exact under emulation, unlike f64
+    division). Decimal scales fold into p and q so the value is the true
+    rational. Invalid where either input is null or den == 0 — the same
+    validity the float `div` produces, so null ordering is unchanged."""
+    def run(expr: BCall, table: DTable, sq) -> DCol:
+        a, b = _args(expr, table, sq)
+        pd = phys_dtype("int")
+        sa = dec_scale(a.dtype) if is_dec(a.dtype) else 0
+        sb = dec_scale(b.dtype) if is_dec(b.dtype) else 0
+        p = a.data.astype(pd) * (10 ** sb)
+        q = b.data.astype(pd) * (10 ** sa)
+        neg = q < 0
+        p = jnp.where(neg, -p, p)
+        q = jnp.where(neg, -q, q)
+        valid = _both(a, b) & (q != 0)
+        qs = jnp.where(q == 0, 1, q)
+        hi = jnp.floor_divide(p, qs)
+        if which == "hi":
+            return DCol("int", jnp.where(valid, hi, 0), valid)
+        r = p - hi * qs                       # in [0, q)
+        if jnp.dtype(pd).itemsize < 8:
+            # no-x64 tier (approximate by config contract): 24 fraction
+            # bits via f32 — r << k would overflow int32 for q >= 2^25
+            frac = r.astype(jnp.float32) / qs.astype(jnp.float32)
+            lo = jnp.floor(frac * (1 << 24)).astype(pd)
+            return DCol("int", jnp.where(valid, lo, 0), valid)
+        # 8 x 7-bit digits (56 fraction bits > the 53 the host's double
+        # keys resolve); r << 7 stays in int64 range while q < 2^56,
+        # far above any NDS-scale aggregate magnitude
+        lo = jnp.zeros_like(r)
+        for _ in range(8):
+            r = r << 7
+            d = jnp.floor_divide(r, qs)
+            r = r - d * qs
+            lo = (lo << 7) | d
+        return DCol("int", jnp.where(valid, lo, 0), valid)
+    return run
+
+
 # -- comparisons -------------------------------------------------------------
 
 _CMP = {"eq": jnp.equal, "ne": jnp.not_equal, "lt": jnp.less,
@@ -531,6 +573,7 @@ def _grouping_bit(expr: BCall, table: DTable, sq) -> DCol:
 _HANDLERS = {
     "add": _arith("add"), "sub": _arith("sub"), "mul": _arith("mul"),
     "div": _arith("div"), "mod": _arith("mod"), "neg": _neg,
+    "ratdiv_hi": _ratdiv("hi"), "ratdiv_lo": _ratdiv("lo"),
     "eq": _compare("eq"), "ne": _compare("ne"), "lt": _compare("lt"),
     "le": _compare("le"), "gt": _compare("gt"), "ge": _compare("ge"),
     "and": _and, "or": _or, "not": _not,

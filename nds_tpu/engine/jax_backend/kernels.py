@@ -254,13 +254,18 @@ def sort_specs(keys: list[SortKey]) -> tuple:
 # scatter-add that segment_sum lowers to by ~600x on TPU (scatters
 # serialize; the broadcast+select fuses into the reduction). COMPILED only:
 # the eager record pass would materialize the (S, n) intermediate (no
-# fusion outside jit), so concrete operands keep the O(n) segment path —
-# both forms compute identical values, so record/replay schedules agree.
+# fusion outside jit), so concrete operands keep the O(n) segment path.
+# For INTEGER operands the two forms compute bit-identical values, so
+# record/replay schedules agree; float reduction order differs in final
+# ULPs between the paths, so float data is kept on the segment path in
+# both modes (the dtype gate below) — no schedule decision may ever be
+# derived from a path-divergent float reduce.
 _MASKED_SEG_MAX = 64
 
 
 def _seg(data: jax.Array, gid: jax.Array, num_segments: int, op: str) -> jax.Array:
-    if num_segments <= _MASKED_SEG_MAX and isinstance(data, jax.core.Tracer):
+    if (num_segments <= _MASKED_SEG_MAX and isinstance(data, jax.core.Tracer)
+            and jnp.issubdtype(data.dtype, jnp.integer)):
         seg_ids = jnp.arange(num_segments, dtype=gid.dtype)
         mask = gid[None, :] == seg_ids[:, None]
         if op == "sum":

@@ -985,6 +985,61 @@ class Planner:
         return node, new_scope, rewrites
 
     # -- windows -------------------------------------------------------------
+    def _exact_rational_keys(self, rel, key: "P.SortKey") -> list:
+        """Rank order keys that are float divisions of integer-typed values
+        (ints or scaled-int decimals) are replaced by TWO exact integer
+        keys — floor(p/q) and 56 binary fraction digits — so rank ties are
+        decided by exact rational equality on every backend. Float division
+        is not correctly rounded under TPU f64 emulation, so equal rationals
+        reached through different operand pairs (2/3 vs 4/6) can land 1 ULP
+        apart and flip ties the host oracle keeps (the failure class the
+        reference validator carves out per-query for floats,
+        nds/nds_validate.py:231-244; exact keys remove the need for any
+        q49 carve-out here). The operands are hoisted through the
+        intervening ProjectNode chain as hidden columns."""
+        chain: list[P.ProjectNode] = []
+        e, node = key.expr, rel
+        while isinstance(e, P.BCol) and isinstance(node, P.ProjectNode):
+            chain.append(node)
+            e = node.exprs[e.index]
+            node = node.child
+        if not (isinstance(e, P.BCall) and e.op == "div"):
+            return [key]
+
+        def strip_cast(x):
+            while isinstance(x, P.BCall) and x.op == "cast" \
+                    and x.dtype == "float":
+                x = x.args[0]
+            return x if x.dtype == "int" or is_dec(x.dtype) else None
+
+        num, den = strip_cast(e.args[0]), strip_cast(e.args[1])
+        if num is None or den is None:
+            return [key]
+
+        def append_col(proj: P.ProjectNode, expr, name: str) -> int:
+            for i, ex in enumerate(proj.exprs):
+                if repr(ex) == repr(expr):
+                    return i
+            proj.exprs.append(expr)
+            proj.out_names.append(name)
+            proj.out_dtypes.append(expr.dtype)
+            return len(proj.exprs) - 1
+
+        cols = []
+        for opnd, tag in ((num, "num"), (den, "den")):
+            if not chain:
+                cols.append(opnd)   # already in rel's scope
+                continue
+            idx = append_col(chain[-1], opnd, f"__rat_{tag}")
+            for proj in reversed(chain[:-1]):
+                idx = append_col(proj, P.BCol(opnd.dtype, idx,
+                                              f"__rat_{tag}"),
+                                 f"__rat_{tag}")
+            cols.append(P.BCol(opnd.dtype, idx, f"__rat_{tag}"))
+        return [P.SortKey(P.BCall("int", op, list(cols)),
+                          key.asc, key.nulls_first)
+                for op in ("ratdiv_hi", "ratdiv_lo")]
+
     def _plan_windows(self, rel, scope, win_calls, binder, ctes, outer):
         uniq: list[A.FuncCall] = []
         for fc in win_calls:
@@ -1003,6 +1058,10 @@ class Planner:
                      for si in fc.over.order_by]
             funcs.append(P.WindowFunc(func, arg, part, okeys,
                                       name=_display_name(fc)))
+        for f in funcs:
+            if f.func in ("rank", "dense_rank") and f.order_by:
+                f.order_by = [k2 for k in f.order_by
+                              for k2 in self._exact_rational_keys(rel, k)]
         out_names = list(rel.out_names) + [f.name for f in funcs]
         out_dtypes = list(rel.out_dtypes) + [f.dtype for f in funcs]
         node = P.WindowNode(rel, funcs, out_names=out_names,

@@ -158,7 +158,8 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
                      profile_folder: str | None = None,
                      fault_inject: list[str] | None = None,
                      keep_sc: bool = False,
-                     decimal: str | None = None) -> list[tuple[str, int, int, int]]:
+                     decimal: str | None = None,
+                     precompile: bool = True) -> list[tuple[str, int, int, int]]:
     """Run every query in the stream; returns (name, start_ms, end_ms, ms).
 
     The CSV time log layout (query name, start, end, elapsed + the
@@ -200,11 +201,47 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
     rows: list[tuple[str, int, int, int]] = []
     fallback_queries: dict[str, list[str]] = {}
     inject = set(fault_inject or ())
+
+    def _injected(name: str) -> bool:
+        return name in inject or re.sub(r"_part[12]$", "", name) in inject
+
+    # phase-structured cold start (warmup >= 1): record EVERY query once,
+    # then compile all recorded programs through the tunnel CONCURRENTLY
+    # (JaxExecutor.precompile_parallel) instead of serial-at-second-run.
+    # The reference's analog is Spark planning at ~ms per query
+    # (nds_power.py:124-134); here parallel compile RPCs turn a cold
+    # stream's wall clock from sum(compiles) into ~max(compiles).
+    eff_warmup = warmup
+    failed_records: set[str] = set()
+    use_jax = (backend == "jax") if backend else config.use_jax
+    if precompile and warmup >= 1 and use_jax:
+        t0 = time.perf_counter()
+        for name, sql in query_dict.items():
+            if _injected(name):
+                continue
+            try:
+                run_one_query(session, sql, name, None, output_format,
+                              backend)
+            except Exception:
+                # possibly transient: give this query its full per-query
+                # warmup back so the timed run is not a first-sighting
+                # eager outlier
+                failed_records.add(name)
+                continue
+        t1 = time.perf_counter()
+        res = session._jax_executor().precompile_parallel()
+        done = sum(1 for v in res.values() if v == "compiled")
+        recorded = sum(1 for n in query_dict
+                       if not _injected(n) and n not in failed_records)
+        print(f"precompile: recorded {recorded} queries in "
+              f"{t1 - t0:.1f}s; compiled {done}/{len(res)} programs in "
+              f"{time.perf_counter() - t1:.1f}s", flush=True)
+        eff_warmup = warmup - 1
+
     power_start = int(time.time() * 1000)
     for name, sql in query_dict.items():
         report = BenchReport(config, app_name=f"NDS-TPU {name}")
-        injected = name in inject or \
-            re.sub(r"_part[12]$", "", name) in inject
+        injected = _injected(name)
         if injected:
             session.last_fallbacks = []     # injected runs never reach the
             session.last_exec_stats = {}    # session; don't report stale state
@@ -212,7 +249,7 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
                 raise RuntimeError(f"injected fault for {name}")
         else:
             run_fn = run_one_query
-            for _ in range(warmup):
+            for _ in range(warmup if name in failed_records else eff_warmup):
                 try:
                     run_one_query(session, sql, name, None, output_format,
                                   backend)
@@ -295,6 +332,9 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--decimal", default=None, choices=["f64", "i64"],
                    help="decimal physical type (i64 = exact scaled int64, "
                         "the spec-faithful measured configuration)")
+    p.add_argument("--no_precompile", action="store_true",
+                   help="disable the record-all-then-compile-parallel cold "
+                        "start (compiles lazily at second execution)")
     a = p.parse_args(argv)
     sub = a.sub_queries.split(",") if a.sub_queries else None
     inject = a.fault_inject.split(",") if a.fault_inject else None
@@ -303,7 +343,7 @@ def main(argv: list[str] | None = None) -> int:
                      a.json_summary_folder, sub, a.property_file, a.backend,
                      warmup=a.warmup, strict=a.strict,
                      profile_folder=a.profile_folder, fault_inject=inject,
-                     decimal=a.decimal)
+                     decimal=a.decimal, precompile=not a.no_precompile)
     return 0
 
 

@@ -1,0 +1,46 @@
+"""Round-5 check: q49 device ranks must equal the numpy oracle exactly
+(no carve-out) now that rank order keys are exact rationals."""
+import glob
+import os
+import sys
+import time
+
+from nds_tpu.config import EngineConfig, apply_decimal, enable_x64
+
+enable_x64()
+from nds_tpu.engine.session import Session
+from nds_tpu.streams import instantiate
+from nds_tpu.warehouse import Warehouse
+
+
+def run(backend: str):
+    s = Session(EngineConfig(decimal_physical="i64"))
+    Warehouse(".bench_data/sf1_wh").register_all(s)
+    sql = [q for q in instantiate(49, 0, 778).split(";") if q.strip()][0]
+    t0 = time.time()
+    res = s.sql(sql, backend=backend)
+    print(f"{backend}: {time.time()-t0:.1f}s, {len(res.columns[0].data)} rows",
+          flush=True)
+    if backend == "jax" and s.last_fallbacks:
+        print("FALLBACKS:", s.last_fallbacks)
+        sys.exit(2)
+    return res
+
+
+a = run("numpy")
+b = run("jax")
+ok = True
+for i, (ca, cb) in enumerate(zip(a.columns, b.columns)):
+    import numpy as np
+    da, db = np.asarray(ca.data), np.asarray(cb.data)
+    if ca.dtype == "float":
+        same = np.allclose(da, db, rtol=1e-7, atol=1e-9)
+    else:
+        same = np.array_equal(da, db)
+    print(f"col {i} ({ca.dtype}): {'OK' if same else 'MISMATCH'}")
+    if not same:
+        ok = False
+        bad = np.nonzero(da != db)[0][:5]
+        print("  rows", bad, da[bad], db[bad])
+print("Q49 EXACT PASS" if ok else "Q49 FAIL")
+sys.exit(0 if ok else 1)

@@ -1,0 +1,55 @@
+"""Device probe 2: which ops are exact under TPU f64 emulation?
+
+  - int64 // and %
+  - int64 -> f64 cast (values < 2^53)
+  - f64 multiply by power of two (gathered from host-constant table)
+  - emulated f64 division error rate vs host
+"""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_enable_x64", True)
+
+rng = np.random.default_rng(0)
+ints = rng.integers(1, 1 << 52, size=4096)
+dens = rng.integers(1, 1 << 40, size=4096)
+ints[:4] = [2, 4, 200, 400]
+dens[:4] = [3, 6, 300, 600]
+a64 = ints.astype(np.float64)
+b64 = dens.astype(np.float64)
+exps = rng.integers(-300, 300, size=4096)
+mant = rng.integers(1 << 52, 1 << 53, size=4096)
+
+POW2 = 2.0 ** np.arange(-340, 341)
+
+
+def probe(ia, ib, e, m):
+    q = ia.astype(jnp.float64) / ib.astype(jnp.float64)
+    qi = ia // ib
+    ri = ia % ib
+    cast = ia.astype(jnp.float64)
+    p2 = jnp.asarray(POW2)[e + 340]
+    scaled = m.astype(jnp.float64) * p2
+    desc = ia.astype(jnp.float64) / 100.0
+    return q, qi, ri, cast, scaled, desc
+
+
+t0 = time.time()
+out = jax.block_until_ready(jax.jit(probe)(
+    jnp.asarray(ints), jnp.asarray(dens), jnp.asarray(exps), jnp.asarray(mant)))
+print(f"compile+run: {time.time()-t0:.1f}s on {jax.devices()[0].platform}")
+q, qi, ri, cast, scaled, desc = [np.asarray(x) for x in out]
+
+print("int // exact:", np.array_equal(qi, ints // dens),
+      "% exact:", np.array_equal(ri, ints % dens))
+print("int->f64 cast exact:", np.array_equal(cast, a64))
+hs = mant.astype(np.float64) * POW2[exps + 340]
+print("m * 2^e exact:", np.array_equal(scaled, hs))
+hq = a64 / b64
+print("div mismatch:", (q != hq).sum(), "of", len(q))
+print("tie pairs device equal:", q[0] == q[1], q[2] == q[3],
+      "host equal:", hq[0] == hq[1], hq[2] == hq[3])
+hd = a64 / 100.0
+print("desc(/100) mismatch:", (desc != hd).sum())

@@ -1,0 +1,42 @@
+"""Does the axon compile tunnel parallelize concurrent compile RPCs?
+
+Compiles 4 unique never-cached programs serially, then 4 more in 4 threads.
+If threaded wall ~= serial wall / 4, parallel compile pre-warming works.
+"""
+import os
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_enable_x64", True)
+
+SALT = int(time.time())  # defeat the persistent cache
+
+
+def make(i):
+    k = SALT * 100 + i
+
+    def f(x):
+        y = x * k + jnp.sin(x) * (k % 7)
+        for j in range(3):
+            y = y @ jnp.eye(64, dtype=x.dtype) * (k + j)
+        return y.sum()
+    return jax.jit(f)
+
+
+x = jnp.ones((64, 64), jnp.float32)
+
+t0 = time.time()
+for i in range(4):
+    make(i).lower(x).compile()
+serial = time.time() - t0
+print(f"serial 4 compiles: {serial:.1f}s")
+
+t0 = time.time()
+with ThreadPoolExecutor(4) as ex:
+    list(ex.map(lambda i: make(i).lower(x).compile(), range(10, 14)))
+par = time.time() - t0
+print(f"threaded 4 compiles: {par:.1f}s  speedup {serial/max(par,1e-9):.2f}x")

@@ -24,3 +24,17 @@ jax.config.update("jax_enable_x64", True)
 from nds_tpu.config import enable_compile_cache  # noqa: E402
 
 enable_compile_cache()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _isolate_shared_programs():
+    """Tests register different data under identical table names/schemas;
+    cross-session program adoption would couple their capacity schedules.
+    Correctness would survive (schedule checks re-record on drift) but test
+    expectations about compile modes would not — keep cases independent."""
+    from nds_tpu.engine.jax_backend.executor import clear_shared_programs
+    clear_shared_programs()
+    yield
+    clear_shared_programs()

@@ -43,6 +43,10 @@ class EngineConfig:
     # a scan streams (rather than pinning device-resident) when its table
     # exceeds this row count
     out_of_core_min_rows: int = 48_000_000
+    # accumulated streamed-partial rows that trigger a host-side compaction
+    # (partial-schema-preserving re-aggregation): bounds host memory when
+    # group cardinality is large (customer-grained q4-class aggregates)
+    stream_compact_rows: int = 8_000_000
     # run jitted per-op kernels (True) or pure-numpy fallback (False, debug only)
     use_jax: bool = True
     # compile whole plans to one XLA program on re-execution (record/replay);

@@ -30,8 +30,10 @@ SELECT d_date_sk AS cr_returned_date_sk,
        cret_return_amt + cret_return_tax + cret_return_fee
          + cret_return_ship_cost - cret_refunded_cash
          - cret_reversed_charge - cret_merchant_credit AS cr_net_loss
+-- join kinds mirror the reference row-for-row (LF_CR.sql: every lookup
+-- LEFT OUTER — failed lookups insert with NULL surrogate keys)
 FROM s_catalog_returns
-JOIN item ON i_item_id = cret_item_id
+LEFT JOIN item ON i_item_id = cret_item_id
 LEFT JOIN date_dim ON d_date = CAST(cret_return_date AS DATE)
 LEFT JOIN time_dim ON t_time = CAST(cret_return_time AS INT)
 LEFT JOIN customer c1 ON c1.c_customer_id = cret_refund_customer_id

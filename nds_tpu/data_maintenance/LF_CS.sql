@@ -38,15 +38,22 @@ SELECT d1.d_date_sk AS cs_sold_date_sk,
          + clin_ship_cost * clin_quantity AS cs_net_paid_inc_ship_tax,
        clin_sales_price * clin_quantity - clin_coupon_amt
          - i_wholesale_cost * clin_quantity AS cs_net_profit
+-- join kinds mirror the reference row-for-row (LF_CS.sql: all dimension
+-- lookups LEFT OUTER; SCD tables item/call_center restrict to the CURRENT
+-- record, *_rec_end_date IS NULL, via pre-filtered builds)
 FROM s_catalog_order
 JOIN s_catalog_order_lineitem ON cord_order_id = clin_order_id
-JOIN item ON i_item_id = clin_item_id
-JOIN date_dim d1 ON d1.d_date = CAST(cord_order_date AS DATE)
+LEFT JOIN (SELECT i_item_sk, i_item_id, i_wholesale_cost, i_current_price
+           FROM item WHERE i_rec_end_date IS NULL) item
+  ON i_item_id = clin_item_id
+LEFT JOIN date_dim d1 ON d1.d_date = CAST(cord_order_date AS DATE)
 LEFT JOIN date_dim d2 ON d2.d_date = CAST(clin_ship_date AS DATE)
 LEFT JOIN time_dim ON t_time = cord_order_time
 LEFT JOIN customer c1 ON c1.c_customer_id = cord_bill_customer_id
 LEFT JOIN customer c2 ON c2.c_customer_id = cord_ship_customer_id
-LEFT JOIN call_center ON cc_call_center_id = cord_call_center_id
+LEFT JOIN (SELECT cc_call_center_sk, cc_call_center_id FROM call_center
+           WHERE cc_rec_end_date IS NULL) call_center
+  ON cc_call_center_id = cord_call_center_id
 LEFT JOIN catalog_page ON cp_catalog_number = clin_catalog_number
   AND cp_catalog_page_number = clin_catalog_page_number
 LEFT JOIN ship_mode ON sm_ship_mode_id = cord_ship_mode_id

@@ -23,8 +23,10 @@ SELECT d_date_sk AS sr_returned_date_sk,
        sret_return_amt + sret_return_tax + sret_return_fee
          + sret_return_ship_cost - sret_refunded_cash
          - sret_reversed_charge - sret_store_credit AS sr_net_loss
+-- join kinds mirror the reference row-for-row (LF_SR.sql: every lookup
+-- LEFT OUTER — failed lookups insert with NULL surrogate keys)
 FROM s_store_returns
-JOIN item ON i_item_id = sret_item_id
+LEFT JOIN item ON i_item_id = sret_item_id
 LEFT JOIN date_dim ON d_date = CAST(sret_return_date AS DATE)
 LEFT JOIN time_dim ON t_time = CAST(sret_return_time AS INT)
 LEFT JOIN customer ON c_customer_id = sret_customer_id

@@ -27,10 +27,13 @@ SELECT d_date_sk AS ss_sold_date_sk,
        ROUND((plin_sale_price * plin_quantity - plin_coupon_amt) * 1.08, 2) AS ss_net_paid_inc_tax,
        plin_sale_price * plin_quantity - plin_coupon_amt
          - i_wholesale_cost * plin_quantity AS ss_net_profit
+-- join kinds mirror the reference row-for-row (LF_SS.sql: every dimension
+-- lookup LEFT OUTER so failed lookups still insert with NULL surrogate
+-- keys; only the order->lineitem join is INNER)
 FROM s_purchase
 JOIN s_purchase_lineitem ON purc_purchase_id = plin_purchase_id
-JOIN item ON i_item_id = plin_item_id
-JOIN date_dim ON d_date = CAST(purc_purchase_date AS DATE)
+LEFT JOIN item ON i_item_id = plin_item_id
+LEFT JOIN date_dim ON d_date = CAST(purc_purchase_date AS DATE)
 LEFT JOIN time_dim ON t_time = purc_purchase_time
 LEFT JOIN customer ON c_customer_id = purc_customer_id
 LEFT JOIN store ON s_store_id = purc_store_id

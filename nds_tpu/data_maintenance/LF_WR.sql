@@ -27,8 +27,10 @@ SELECT d_date_sk AS wr_returned_date_sk,
        wret_return_amt + wret_return_tax + wret_return_fee
          + wret_return_ship_cost - wret_refunded_cash
          - wret_reversed_charge - wret_account_credit AS wr_net_loss
+-- join kinds mirror the reference row-for-row (LF_WR.sql: every lookup
+-- LEFT OUTER — failed lookups insert with NULL surrogate keys)
 FROM s_web_returns
-JOIN item ON i_item_id = wret_item_id
+LEFT JOIN item ON i_item_id = wret_item_id
 LEFT JOIN date_dim ON d_date = CAST(wret_return_date AS DATE)
 LEFT JOIN time_dim ON t_time = CAST(wret_return_time AS INT)
 LEFT JOIN customer c1 ON c1.c_customer_id = wret_refund_customer_id

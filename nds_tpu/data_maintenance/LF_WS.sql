@@ -38,16 +38,25 @@ SELECT d1.d_date_sk AS ws_sold_date_sk,
          + wlin_ship_cost * wlin_quantity AS ws_net_paid_inc_ship_tax,
        wlin_sales_price * wlin_quantity - wlin_coupon_amt
          - i_wholesale_cost * wlin_quantity AS ws_net_profit
+-- join kinds mirror the reference row-for-row (LF_WS.sql: all dimension
+-- lookups LEFT OUTER; the SCD tables item/web_page/web_site restrict to
+-- the CURRENT record, *_rec_end_date IS NULL, via pre-filtered builds)
 FROM s_web_order
 JOIN s_web_order_lineitem ON word_order_id = wlin_order_id
-JOIN item ON i_item_id = wlin_item_id
-JOIN date_dim d1 ON d1.d_date = CAST(word_order_date AS DATE)
+LEFT JOIN (SELECT i_item_sk, i_item_id, i_wholesale_cost, i_current_price
+           FROM item WHERE i_rec_end_date IS NULL) item
+  ON i_item_id = wlin_item_id
+LEFT JOIN date_dim d1 ON d1.d_date = CAST(word_order_date AS DATE)
 LEFT JOIN date_dim d2 ON d2.d_date = CAST(wlin_ship_date AS DATE)
 LEFT JOIN time_dim ON t_time = word_order_time
 LEFT JOIN customer c1 ON c1.c_customer_id = word_bill_customer_id
 LEFT JOIN customer c2 ON c2.c_customer_id = word_ship_customer_id
-LEFT JOIN web_page ON wp_web_page_id = wlin_web_page_id
-LEFT JOIN web_site ON web_site_id = word_web_site_id
+LEFT JOIN (SELECT wp_web_page_sk, wp_web_page_id FROM web_page
+           WHERE wp_rec_end_date IS NULL) web_page
+  ON wp_web_page_id = wlin_web_page_id
+LEFT JOIN (SELECT web_site_sk, web_site_id FROM web_site
+           WHERE web_rec_end_date IS NULL) web_site
+  ON web_site_id = word_web_site_id
 LEFT JOIN ship_mode ON sm_ship_mode_id = word_ship_mode_id
 LEFT JOIN warehouse ON w_warehouse_id = wlin_warehouse_id
 LEFT JOIN promotion ON p_promo_id = wlin_promotion_id;

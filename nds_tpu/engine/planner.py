@@ -124,6 +124,20 @@ class Planner:
             if not os.environ.get("NDS_TPU_NO_COLPRUNE"):
                 from .colprune import prune_plan
                 node = prune_plan(node)
+            if not os.environ.get("NDS_TPU_NO_SELFJOIN_REWRITE"):
+                # AFTER pruning (dead columns would hide the single-column
+                # key-set shape), and pruned again when it fired (the
+                # rewrite kills the pair-expansion column uses)
+                node2 = _selfjoin_distinct_rewrite(node)
+                if node2 is not node:
+                    segs = getattr(node, "cte_segments", [])
+                    live = {id(n) for n in P.iter_plan_nodes(node2)}
+                    node2.cte_segments = [(fp, n) for fp, n in segs
+                                          if id(n) in live]
+                    node = node2
+                    if not os.environ.get("NDS_TPU_NO_COLPRUNE"):
+                        from .colprune import prune_plan
+                        node = prune_plan(node)
         return node
 
     def _plan_cte(self, name: str, cq: A.Query, ctes: dict) -> P.PlanNode:
@@ -1084,6 +1098,179 @@ class Planner:
 # ---------------------------------------------------------------------------
 # binder: AST expression -> bound expression
 # ---------------------------------------------------------------------------
+
+def _selfjoin_distinct_rewrite(plan: P.PlanNode) -> P.PlanNode:
+    """q95-class exact rewrite: a CTE like
+
+        SELECT ws1.ws_order_number FROM web_sales ws1, web_sales ws2
+        WHERE ws1.ws_order_number = ws2.ws_order_number
+          AND ws1.ws_warehouse_sk <> ws2.ws_warehouse_sk
+
+    consumed ONLY as a key set (semi/anti-join build sides — IN/EXISTS
+    subqueries) is equivalent to
+
+        SELECT ws_order_number FROM web_sales GROUP BY ws_order_number
+        HAVING MIN(ws_warehouse_sk) < MAX(ws_warehouse_sk)
+
+    because `exists a pair with different x` == `more than one distinct
+    non-null x in the key group` (SQL `<>` is null-rejecting, and MIN/MAX
+    skip nulls). The literal self-join expands to |key-group|^2 pairs —
+    the single hottest buffer class in the whole stream (the q95 expand
+    join's 16M-row gathers spill to host memory); the aggregate form is a
+    couple of segment scans. The reference leaves this to Spark, which
+    executes the join literally (nds_power runs the stock template) — this
+    engine plans it away."""
+    refs: dict[int, list] = {}
+    for n in P.iter_plan_nodes(plan):
+        for f in ("child", "left", "right"):
+            sub = getattr(n, f, None)
+            if isinstance(sub, P.PlanNode):
+                refs.setdefault(id(sub), []).append((n, f))
+
+    # transitively-consumed column sets, from colprune's needed-set pass:
+    # a candidate qualifies when its consumers provably read ONLY the key
+    # column (other columns — a CTE root kept full-width for segment
+    # fingerprints — may exist but are dead)
+    from .colprune import _Pruner
+    pr = _Pruner()
+    pr.collect(plan, set(range(len(plan.out_names))))
+
+    def match(r: P.PlanNode):
+        """r -> (scan, key_idx, x_idx, key_pos) when r is the pattern."""
+        # walk down pure-BCol projects and ne-filters, composing the map
+        # from current output positions back to the join output space
+        node = r
+        proj_chain: list = []
+        filters: list = []
+        while True:
+            if isinstance(node, P.ProjectNode) and \
+                    all(isinstance(e, P.BCol) for e in node.exprs):
+                proj_chain.append([e.index for e in node.exprs])
+                node = node.child
+            elif isinstance(node, P.FilterNode):
+                filters.append((node.predicate, len(proj_chain)))
+                node = node.child
+            else:
+                break
+        if not isinstance(node, P.JoinNode) or node.kind != "inner" \
+                or node.residual is not None:
+            return None
+        jl, jr = node.left, node.right
+        if not (isinstance(jl, P.ScanNode) and isinstance(jr, P.ScanNode)
+                and jl.table == jr.table
+                and list(jl.columns) == list(jr.columns)):
+            return None
+        if len(node.left_keys) != 1 or len(node.right_keys) != 1:
+            return None
+        lk, rk = node.left_keys[0], node.right_keys[0]
+        if not (isinstance(lk, P.BCol) and isinstance(rk, P.BCol)
+                and lk.index == rk.index):
+            return None
+        w = len(jl.out_names)
+        k = lk.index
+
+        def to_join_space(idx: int, depth: int) -> int:
+            # compose through projects BELOW depth (later entries are
+            # deeper): proj_chain[depth:] maps r-space -> join-space
+            for m in proj_chain[depth:]:
+                idx = m[idx]
+            return idx
+
+        # consumers must read exactly one column of r, and it must be the
+        # join key (dedup-safety licenses multiplicity changes only —
+        # value columns must be provably dead)
+        consumed = pr.needed.get(id(r))
+        if consumed is None or len(consumed) != 1:
+            return None
+        key_pos = next(iter(consumed))
+        if to_join_space(key_pos, 0) not in (k, w + k):
+            return None
+        # exactly one ne(x_left, x_right) filter over the same column
+        if len(filters) != 1:
+            return None
+        pred, depth = filters[0]
+        if not (isinstance(pred, P.BCall) and pred.op == "ne"
+                and len(pred.args) == 2
+                and all(isinstance(a, P.BCol) for a in pred.args)):
+            return None
+        i, j = (to_join_space(a.index, depth) for a in pred.args)
+        if i > j:
+            i, j = j, i
+        if j != w + i or i == k:
+            return None
+        return jl, k, i, key_pos
+
+    # A node is DEDUP-SAFE when every path from it to an output passes
+    # through a set-semantics consumer (semi/anti build side, DISTINCT,
+    # non-ALL set op) via multiplicity-preserving nodes — then changing its
+    # row multiplicities (the rewrite dedups) cannot change any result.
+    safe_memo: dict[int, bool] = {}
+
+    def dedup_safe(node: P.PlanNode) -> bool:
+        if id(node) in safe_memo:
+            return safe_memo[id(node)]
+        safe_memo[id(node)] = False          # cycle guard, conservative
+        rs = refs.get(id(node))
+        if not rs:          # plan root / subquery root: rows reach output
+            out = False
+        else:
+            def ok(p, f):
+                if isinstance(p, P.JoinNode) and p.kind in ("semi", "anti") \
+                        and f == "right":
+                    return True
+                if isinstance(p, P.DistinctNode):
+                    return True      # output multiplicity is 1 regardless
+                if isinstance(p, P.SetOpNode) and not p.all:
+                    return True      # set semantics dedup anyway
+                if isinstance(p, (P.ProjectNode, P.FilterNode, P.JoinNode)):
+                    return dedup_safe(p)
+                if isinstance(p, P.SetOpNode) and p.op == "union" and p.all:
+                    return dedup_safe(p)
+                return False
+            out = all(ok(p, f) for p, f in rs)
+        safe_memo[id(node)] = out
+        return out
+
+    mapping: dict[int, P.PlanNode] = {}
+    for r in P.iter_plan_nodes(plan):
+        if id(r) in mapping or not dedup_safe(r):
+            continue
+        m = match(r)
+        if m is None:
+            continue
+        scan, k, x, key_pos = m
+        dk = scan.out_dtypes[k]
+        dx = scan.out_dtypes[x]
+        key_name = r.out_names[key_pos]
+        agg = P.AggregateNode(
+            child=scan, group_exprs=[P.BCol(dk, k, scan.out_names[k])],
+            aggs=[P.AggSpec("min", P.BCol(dx, x), False, "__mn"),
+                  P.AggSpec("max", P.BCol(dx, x), False, "__mx")],
+            out_names=[key_name, "__mn", "__mx"],
+            out_dtypes=[dk, dx, dx])
+        # key IS NOT NULL: the literal self-join's equality can never match
+        # NULL keys, but GROUP BY keeps the NULL group — without the filter
+        # a NOT IN consumer (null-aware anti join) would see a spurious
+        # NULL and return zero rows
+        flt = P.FilterNode(
+            agg, P.BCall("bool", "and", [
+                P.BCall("bool", "isnotnull", [P.BCol(dk, 0, key_name)]),
+                P.BCall("bool", "lt", [P.BCol(dx, 1, "__mn"),
+                                       P.BCol(dx, 2, "__mx")])]),
+            out_names=list(agg.out_names), out_dtypes=list(agg.out_dtypes))
+        # same width as r: non-key columns are PROVEN dead (consumed set
+        # is exactly the key), so they carry typed NULLs
+        exprs = [P.BCol(dk, 0, key_name) if i == key_pos
+                 else P.BLit(r.out_dtypes[i], None)
+                 for i in range(len(r.out_names))]
+        proj = P.ProjectNode(flt, exprs, out_names=list(r.out_names),
+                             out_dtypes=list(r.out_dtypes))
+        mapping[id(r)] = proj
+    if not mapping:
+        return plan
+    from .streaming import substitute_nodes
+    return substitute_nodes(plan, mapping)
+
 
 def _ast_key(node) -> str:
     return repr(node)

@@ -69,8 +69,11 @@ def _big_scan(sub: PlanNode, est_rows, threshold: int
     The big scan must sit on the LEFT spine (probe side): every JoinNode on
     the path from the aggregate to it must have the big lineage as `left`
     with an inner/left/semi/anti kind, and all other scans must be small.
+    Scans inside expression subqueries count too (iter_plan_nodes): a
+    scalar subquery over the big table would otherwise embed a full
+    big-table scan in every morsel program.
     """
-    scans = [n for n in walk(sub) if isinstance(n, ScanNode)]
+    scans = [n for n in P.iter_plan_nodes(sub) if isinstance(n, ScanNode)]
     big = [s for s in scans if est_rows(s.table) > threshold]
     if len(big) != 1:
         return None
@@ -94,9 +97,15 @@ def _big_scan(sub: PlanNode, est_rows, threshold: int
 
 
 def _contains_unsupported(sub: PlanNode, big: ScanNode) -> bool:
-    for n in walk(sub):
+    """Unsupported nodes block streaming ONLY when the big scan flows
+    through them (the morsel boundary would split their semantics).
+    Window/distinct/setop/aggregate shapes on the small side — q6/q8-class
+    scalar-subquery joins over dimensions — execute whole inside every
+    morsel program and stay correct."""
+    for n in P.iter_plan_nodes(sub):
         if isinstance(n, (P.WindowNode, P.DistinctNode, P.SetOpNode,
-                          AggregateNode)):
+                          AggregateNode)) \
+                and any(m is big for m in P.iter_plan_nodes(n)):
             return True
     # string payloads from the big scan would need per-morsel dictionaries
     # (one compiled program could not be reused); group keys and filters on
@@ -109,24 +118,73 @@ def _contains_unsupported(sub: PlanNode, big: ScanNode) -> bool:
 
 def try_streaming_plan(plan: PlanNode, est_rows, threshold: int
                        ) -> Optional[StreamingPlan]:
+    """Single top-path streamable aggregate (the original API, kept for
+    eligibility tests): a thin view over the generalized _try_job
+    machinery — one branch, one big scan, post-agg path preserved."""
     path, agg = _path_to_aggregate(plan)
     if agg is None:
         return None
-    if any(s.distinct for s in agg.aggs):
+    job = _try_job(agg, est_rows, threshold)
+    if job is None or len(job.branches) != 1 \
+            or job.branches[0].big_table is None:
         return None
-    if any(s.func not in ("sum", "count", "count_star", "min", "max", "avg")
-           for s in agg.aggs):
-        return None
-    big = _big_scan(agg.child, est_rows, threshold)
-    if big is None or _contains_unsupported(agg.child, big):
-        return None
-    if any(isinstance(n, MaterializedNode) for n in walk(agg.child)):
-        return None
+    b = job.branches[0]
+    return StreamingPlan(b.big_table, list(b.big_columns), b.partial_plan,
+                         job.partial_names, job.partial_dtypes,
+                         job.build_final, path)
 
-    # ---- partial aggregate: decompose each agg into mergeable pieces ----
+
+
+# ---------------------------------------------------------------------------
+# generalized streaming (round 5): materialize EVERY maximal streamable
+# aggregate subtree anywhere in the plan — not just a single top-path
+# aggregate — with UNION ALL branch support, so multi-fact-channel queries
+# (q2/q4/q5-class ss+cs+ws unions) and aggregates below joins stream too.
+# Reference frame: Spark chunks every scan via maxPartitionBytes and spills
+# shuffles regardless of plan position (power_run_gpu.template SPARK_CONF).
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class BranchStream:
+    """One UNION ALL branch of a streamable aggregate."""
+    partial_plan: PlanNode          # partial agg over this branch
+    big_table: Optional[str]        # None => in-core one-shot branch
+    big_columns: list[str]
+
+
+@dataclasses.dataclass
+class StreamJob:
+    """A streamable aggregate subtree: stream each branch, union the
+    partials, combine/merge, substitute a MaterializedNode for `agg`.
+
+    For semi/anti joins whose BUILD side holds the big scan (q10/q16-class
+    EXISTS subqueries), `agg` is a SYNTHESIZED distinct-key aggregate over
+    the join's right side: `join_patch` names the join whose right/
+    right_keys get patched to the materialized key set (semi/anti only
+    consume the right-side key SET, so dedup preserves semantics, including
+    null-aware NOT IN — the NULL group survives the group-by)."""
+    agg: AggregateNode
+    branches: list[BranchStream]
+    partial_names: list[str]
+    partial_dtypes: list[str]
+    build_final: "callable"        # (partials Materialized) -> final PlanNode
+    build_combine: "callable"      # (partials Materialized) -> partial-schema
+    # re-aggregation plan for periodic compaction of accumulated partials
+    join_patch: Optional[JoinNode] = None
+
+
+def _mergeable(agg: AggregateNode) -> bool:
+    if any(s.distinct for s in agg.aggs):
+        return False
+    return all(s.func in ("sum", "count", "count_star", "min", "max", "avg")
+               for s in agg.aggs)
+
+
+def _decompose(agg: AggregateNode):
+    """Per-branch partial agg specs + merge recipes (shared logic with the
+    single-path flow)."""
     ngroups = len(agg.group_exprs)
     partial_specs: list[AggSpec] = []
-    # merge recipe per original agg: list of (piece kind, partial col index)
     recipes: list[tuple[str, list[int]]] = []
     for spec in agg.aggs:
         base = len(partial_specs) + ngroups
@@ -150,36 +208,23 @@ def try_streaming_plan(plan: PlanNode, est_rows, threshold: int
             partial_specs.append(AggSpec("count", spec.arg, False,
                                          f"{spec.name}__n"))
             recipes.append(("avg", [base, base + 1]))
-
-    # swap the big scan for the morsel pseudo-table
-    def swap(node: PlanNode) -> PlanNode:
-        if node is big:
-            return replace(node, table=MORSEL_TABLE)
-        repl = {}
-        for f in ("child", "left", "right"):
-            sub = getattr(node, f, None)
-            if isinstance(sub, PlanNode):
-                repl[f] = swap(sub)
-        return replace(node, **repl) if repl else node
-
     p_names = ([f"g{i}" for i in range(ngroups)] +
                [s.name for s in partial_specs])
     p_dtypes = ([e.dtype for e in agg.group_exprs] +
                 [s.dtype for s in partial_specs])
     if agg.rollup:
-        # per-prefix partials: the partial aggregate emits every rollup
-        # grouping set per morsel (rolled-up cols NULL + __grouping_id),
-        # and the merge re-groups on (group cols..., __grouping_id)
         p_names = p_names + ["__grouping_id"]
         p_dtypes = p_dtypes + ["int"]
-    partial_plan = AggregateNode(
-        child=swap(agg.child), group_exprs=list(agg.group_exprs),
-        aggs=partial_specs, rollup=agg.rollup,
-        out_names=p_names, out_dtypes=p_dtypes)
+    return partial_specs, recipes, p_names, p_dtypes
+
+
+def _final_builder(agg: AggregateNode, recipes, p_names, p_dtypes):
+    """The merge-plan factory over unioned partials (identical semantics to
+    the single-path flow's build_final)."""
+    ngroups = len(agg.group_exprs)
 
     def build_final(partials: MaterializedNode) -> PlanNode:
-        """Re-aggregate the unioned partials, then restore A's schema."""
-        nmerge = ngroups + (1 if agg.rollup else 0)   # + __grouping_id
+        nmerge = ngroups + (1 if agg.rollup else 0)
         gidx = list(range(ngroups))
         if agg.rollup:
             gidx.append(len(p_names) - 1)
@@ -200,7 +245,6 @@ def try_streaming_plan(plan: PlanNode, est_rows, threshold: int
         merged = AggregateNode(child=partials, group_exprs=group_refs,
                                aggs=merge_specs,
                                out_names=m_names, out_dtypes=m_dtypes)
-        # project back to A's output schema
         exprs: list = [BCol(m_dtypes[i], i, m_names[i])
                        for i in range(ngroups)]
         col = nmerge
@@ -209,25 +253,350 @@ def try_streaming_plan(plan: PlanNode, est_rows, threshold: int
                 exprs.append(BCol(spec.dtype, col))
                 col += 1
             elif kind == "sum_guarded":
-                # SUM is NULL iff no non-null input existed anywhere
                 s_ref = BCol(m_dtypes[col], col)
                 n_ref = BCol("int", col + 1)
                 cond = BCall("bool", "gt", [n_ref, P.BLit("int", 0)])
                 exprs.append(BCall(spec.dtype, "case",
                                    [cond, s_ref, P.BLit(spec.dtype, None)]))
                 col += 2
-            else:  # avg = total sum / total count (NULL when count == 0)
+            else:  # avg
                 s_ref = BCol(m_dtypes[col], col)
                 n_ref = BCol("int", col + 1)
                 exprs.append(BCall("float", "div", [s_ref, n_ref]))
                 col += 2
-        if agg.rollup:     # __grouping_id is the LAST output column
+        if agg.rollup:
             exprs.append(BCol("int", ngroups, "__grouping_id"))
         return ProjectNode(merged, exprs, out_names=list(agg.out_names),
                            out_dtypes=list(agg.out_dtypes))
+    return build_final
 
-    return StreamingPlan(big.table, list(big.columns), partial_plan,
-                         p_names, p_dtypes, build_final, path)
+
+def _combine_builder(agg: AggregateNode, recipes, p_names, p_dtypes):
+    """Partial-schema-preserving re-aggregation: compacts accumulated
+    partials mid-stream (bounds host memory when group cardinality is
+    large, e.g. customer-grained q4-class aggregates at SF100). Associative
+    and idempotent — safe to apply any number of times before build_final."""
+    ngroups = len(agg.group_exprs)
+
+    def build_combine(partials: MaterializedNode) -> PlanNode:
+        gidx = list(range(ngroups))
+        if agg.rollup:
+            gidx.append(len(p_names) - 1)
+        group_refs = [BCol(p_dtypes[i], i, p_names[i]) for i in gidx]
+        specs: list[AggSpec] = []
+        piece_cols = []
+        for _spec, (kind, idxs) in zip(agg.aggs, recipes):
+            for pos, j in enumerate(idxs):
+                func = kind if kind in ("min", "max") else "sum"
+                specs.append(AggSpec(func, BCol(p_dtypes[j], j), False,
+                                     p_names[j]))
+                piece_cols.append(j)
+        a_names = [p_names[i] for i in gidx] + [s.name for s in specs]
+        a_dtypes = [p_dtypes[i] for i in gidx] + [s.dtype for s in specs]
+        merged = AggregateNode(child=partials, group_exprs=group_refs,
+                               aggs=specs, out_names=a_names,
+                               out_dtypes=a_dtypes)
+        # project back into the exact partial column order
+        exprs: list = []
+        for i in range(len(p_names)):
+            if i < ngroups:
+                exprs.append(BCol(p_dtypes[i], i, p_names[i]))
+            elif agg.rollup and i == len(p_names) - 1:
+                exprs.append(BCol("int", ngroups, "__grouping_id"))
+            else:
+                pos = piece_cols.index(i)
+                src = len(gidx) + pos
+                exprs.append(BCol(a_dtypes[src], src, p_names[i]))
+        return ProjectNode(merged, exprs, out_names=list(p_names),
+                           out_dtypes=list(p_dtypes))
+    return build_combine
+
+
+def _union_branches(child: PlanNode) -> list[PlanNode]:
+    """Flatten a UNION ALL found on the LEFT spine (through Project/Filter
+    nodes and probe sides of joins — the q2/q5 shape is
+    agg(join(union(ss,cs,ws), dims))) into per-branch plans with the spine
+    cloned atop each branch; [child] when there is no union."""
+    spine: list[tuple[PlanNode, str]] = []
+    node = child
+    while True:
+        if isinstance(node, (ProjectNode, FilterNode)):
+            spine.append((node, "child"))
+            node = node.child
+        elif isinstance(node, JoinNode) and node.kind in (
+                "inner", "left", "semi", "anti"):
+            spine.append((node, "left"))
+            node = node.left
+        else:
+            break
+    if not (isinstance(node, P.SetOpNode) and node.op == "union" and node.all):
+        return [child]
+    branches: list[PlanNode] = []
+
+    def flat(n: PlanNode) -> None:
+        if isinstance(n, P.SetOpNode) and n.op == "union" and n.all:
+            flat(n.left)
+            flat(n.right)
+        else:
+            branches.append(n)
+
+    flat(node)
+    out = []
+    for b in branches:
+        nb = b
+        for parent, field in reversed(spine):
+            nb = replace(parent, **{field: nb})
+        out.append(nb)
+    return out
+
+
+def _commute_join(join: JoinNode) -> PlanNode:
+    """Swap an INNER join's sides (keys swapped, residual remapped) and
+    restore the original column order with a Project, so the big scan
+    lands on the probe (left) spine."""
+    from .colprune import _remap_expr
+
+    wl, wr = len(join.left.out_names), len(join.right.out_names)
+    mapping = {i: wr + i for i in range(wl)}
+    mapping.update({wl + j: j for j in range(wr)})
+    residual = None if join.residual is None else \
+        _remap_expr(join.residual, mapping)
+    swapped = JoinNode(
+        join.right, join.left, "inner",
+        left_keys=list(join.right_keys), right_keys=list(join.left_keys),
+        residual=residual, null_aware=join.null_aware,
+        out_names=list(join.right.out_names) + list(join.left.out_names),
+        out_dtypes=list(join.right.out_dtypes) + list(join.left.out_dtypes))
+    perm = [BCol(join.out_dtypes[i], wr + i, join.out_names[i])
+            for i in range(wl)] + \
+           [BCol(join.out_dtypes[wl + j], j, join.out_names[wl + j])
+            for j in range(wr)]
+    return ProjectNode(swapped, perm, out_names=list(join.out_names),
+                       out_dtypes=list(join.out_dtypes))
+
+
+def _rotate_big_left(node: PlanNode, est_rows, threshold: int) -> PlanNode:
+    """Canonicalize the probe spine: INNER joins whose BUILD side holds the
+    big scan commute (q2-class date_dim-join-union plans), so the
+    left-spine rule sees the streamable orientation. Descends Project/
+    Filter chains, union branches, and probe sides."""
+    def has_big(n: PlanNode) -> bool:
+        return any(isinstance(m, ScanNode) and est_rows(m.table) > threshold
+                   for m in P.iter_plan_nodes(n))
+
+    if isinstance(node, (ProjectNode, FilterNode)):
+        child = _rotate_big_left(node.child, est_rows, threshold)
+        return node if child is node.child else replace(node, child=child)
+    if isinstance(node, P.SetOpNode) and node.op == "union" and node.all:
+        left = _rotate_big_left(node.left, est_rows, threshold)
+        right = _rotate_big_left(node.right, est_rows, threshold)
+        if left is node.left and right is node.right:
+            return node
+        return replace(node, left=left, right=right)
+    if isinstance(node, JoinNode):
+        if node.kind == "inner" and has_big(node.right) \
+                and not has_big(node.left):
+            return _rotate_big_left(_commute_join(node), est_rows, threshold)
+        if node.kind in ("inner", "left", "semi", "anti"):
+            left = _rotate_big_left(node.left, est_rows, threshold)
+            return node if left is node.left else replace(node, left=left)
+    return node
+
+
+def _swap_scan(plan: PlanNode, big: ScanNode) -> PlanNode:
+    def swap(node: PlanNode) -> PlanNode:
+        if node is big:
+            return replace(node, table=MORSEL_TABLE)
+        repl = {}
+        for f in ("child", "left", "right"):
+            sub = getattr(node, f, None)
+            if isinstance(sub, PlanNode):
+                repl[f] = swap(sub)
+        return replace(node, **repl) if repl else node
+    return swap(plan)
+
+
+def _try_job(agg: AggregateNode, est_rows, threshold: int
+             ) -> Optional[StreamJob]:
+    if not _mergeable(agg):
+        return None
+    branches = _union_branches(
+        _rotate_big_left(agg.child, est_rows, threshold))
+    partial_specs, recipes, p_names, p_dtypes = _decompose(agg)
+    bstreams: list[BranchStream] = []
+    saw_big = False
+    for b in branches:
+        if any(isinstance(n, MaterializedNode) for n in P.iter_plan_nodes(b)):
+            return None
+        bigs = [n for n in P.iter_plan_nodes(b) if isinstance(n, ScanNode)
+                and est_rows(n.table) > threshold]
+        if not bigs:
+            bstreams.append(BranchStream(
+                AggregateNode(child=b, group_exprs=list(agg.group_exprs),
+                              aggs=list(partial_specs), rollup=agg.rollup,
+                              out_names=list(p_names),
+                              out_dtypes=list(p_dtypes)),
+                None, []))
+            continue
+        big = _big_scan(b, est_rows, threshold)
+        if big is None or _contains_unsupported(b, big):
+            return None
+        saw_big = True
+        bstreams.append(BranchStream(
+            AggregateNode(child=_swap_scan(b, big),
+                          group_exprs=list(agg.group_exprs),
+                          aggs=list(partial_specs), rollup=agg.rollup,
+                          out_names=list(p_names), out_dtypes=list(p_dtypes)),
+            big.table, list(big.columns)))
+    if not saw_big:
+        return None
+    return StreamJob(agg, bstreams, p_names, p_dtypes,
+                     _final_builder(agg, recipes, p_names, p_dtypes),
+                     _combine_builder(agg, recipes, p_names, p_dtypes))
+
+
+def _expr_subplans(node: PlanNode):
+    """Plans embedded in this node's EXPRESSIONS (BScalarSubquery) —
+    q9-class scalar-subquery aggregates over big scans live there."""
+    out: list[PlanNode] = []
+
+    def rec(x) -> None:
+        if isinstance(x, P.BScalarSubquery):
+            out.append(x.plan)
+            return
+        if isinstance(x, PlanNode):
+            return                    # child plans handled by the visitor
+        if dataclasses.is_dataclass(x) and not isinstance(x, type):
+            for f in dataclasses.fields(x):
+                rec(getattr(x, f.name))
+        elif isinstance(x, (list, tuple)):
+            for v in x:
+                rec(v)
+
+    for f in dataclasses.fields(node):
+        if f.name in ("child", "left", "right"):
+            continue
+        rec(getattr(node, f.name))
+    return out
+
+
+def _try_semi_join_job(join: JoinNode, est_rows, threshold: int
+                       ) -> Optional[StreamJob]:
+    """Semi/anti join whose RIGHT (build) side holds the big scan: stream a
+    synthesized distinct-key aggregate of the right side, then patch the
+    join to probe the materialized key set."""
+    if join.kind not in ("semi", "anti") or join.residual is not None:
+        return None
+    if not join.right_keys:
+        return None
+    bigs = [n for n in P.iter_plan_nodes(join.right) if isinstance(n, ScanNode)
+            and est_rows(n.table) > threshold]
+    if not bigs:
+        return None
+    key_names = [f"k{i}" for i in range(len(join.right_keys))]
+    key_dtypes = [e.dtype for e in join.right_keys]
+    synth = AggregateNode(
+        child=join.right, group_exprs=list(join.right_keys),
+        aggs=[AggSpec("count_star", None, False, "__n")],
+        out_names=key_names + ["__n"], out_dtypes=key_dtypes + ["int"])
+    job = _try_job(synth, est_rows, threshold)
+    if job is None:
+        return None
+    job.join_patch = join
+    return job
+
+
+def find_streaming_jobs(plan: PlanNode, est_rows, threshold: int
+                        ) -> list[StreamJob]:
+    """Every MAXIMAL streamable aggregate subtree in the plan — including
+    scalar-subquery plans (q9) and semi/anti-join build sides (q10) —
+    pre-order; a qualifying aggregate claims its whole subtree. Shared
+    nodes (CTE DAGs) yield one job serving every parent."""
+    jobs: list[StreamJob] = []
+    seen: set[int] = set()
+
+    def visit(node: PlanNode) -> None:
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        claimed = False
+        if isinstance(node, AggregateNode):
+            job = _try_job(node, est_rows, threshold)
+            if job is not None:
+                jobs.append(job)
+                claimed = True
+        if not claimed and isinstance(node, JoinNode):
+            job = _try_semi_join_job(node, est_rows, threshold)
+            if job is not None:
+                jobs.append(job)
+                visit(node.left)      # probe side still gets its chance
+                claimed = True
+        if not claimed:
+            for f in ("child", "left", "right"):
+                sub = getattr(node, f, None)
+                if isinstance(sub, PlanNode):
+                    visit(sub)
+        for sub in _expr_subplans(node):
+            visit(sub)
+
+    visit(plan)
+    return jobs
+
+
+def substitute_nodes(root: PlanNode, mapping: dict) -> PlanNode:
+    """Rebuild `root` with nodes replaced by id. Mapping values are either
+    a replacement PlanNode (subtree swap, no descent) or a dict of field
+    patches applied AFTER children rebuild (semi-join right-side swap).
+    Descends expression-embedded subquery plans too; shared nodes rebuild
+    once, preserving DAG sharing."""
+    memo: dict[int, PlanNode] = {}
+
+    def rw_any(x):
+        if isinstance(x, PlanNode):
+            return rw(x)
+        if isinstance(x, P.BScalarSubquery):
+            p = rw(x.plan)
+            return x if p is x.plan else replace(x, plan=p)
+        if isinstance(x, MaterializedNode):
+            return x
+        if dataclasses.is_dataclass(x) and not isinstance(x, type):
+            changes = {}
+            for f in dataclasses.fields(x):
+                v = getattr(x, f.name)
+                nv = rw_any(v)
+                if nv is not v:
+                    changes[f.name] = nv
+            return replace(x, **changes) if changes else x
+        if isinstance(x, list):
+            out = [rw_any(v) for v in x]
+            return out if any(a is not b for a, b in zip(out, x)) else x
+        if isinstance(x, tuple):
+            out = tuple(rw_any(v) for v in x)
+            return out if any(a is not b for a, b in zip(out, x)) else x
+        return x
+
+    def rw(node: PlanNode) -> PlanNode:
+        patch = mapping.get(id(node))
+        if isinstance(patch, PlanNode):
+            return patch
+        if id(node) in memo:
+            return memo[id(node)]
+        if isinstance(node, MaterializedNode):
+            memo[id(node)] = node
+            return node
+        repl = {}
+        for f in dataclasses.fields(node):
+            v = getattr(node, f.name)
+            nv = rw_any(v)
+            if nv is not v:
+                repl[f.name] = nv
+        out = replace(node, **repl) if repl else node
+        if isinstance(patch, dict):
+            out = replace(out, **patch)
+        memo[id(node)] = out
+        return out
+
+    return rw(root)
 
 
 def rebuild_above(path: list[PlanNode], new_agg_out: PlanNode) -> PlanNode:

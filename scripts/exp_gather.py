@@ -1,0 +1,85 @@
+"""Gather microbench: 16M random indices into a 1M-entry i32 table.
+
+1. XLA gather (src[idx])
+2. XLA gather, sorted indices
+3. Pallas kernel: src in VMEM, vector dynamic indexing
+"""
+import functools
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_enable_x64", True)
+
+NI, NS = 1 << 24, 1 << 20
+rng = np.random.default_rng(0)
+idx = jnp.asarray(rng.integers(0, NS, NI), jnp.int32)
+idx_sorted = jnp.sort(idx)
+src = jnp.asarray(rng.integers(0, 1 << 30, NS), jnp.int32)
+
+
+def bench(name, fn, *args):
+    try:
+        f = jax.jit(fn)
+        jax.block_until_ready(f(*args))
+        t0 = time.perf_counter()
+        for _ in range(3):
+            r = jax.block_until_ready(f(*args))
+        dt = (time.perf_counter() - t0) / 3 * 1000
+        print(f"{name:24s} {dt:8.1f} ms", flush=True)
+        return r
+    except Exception as e:
+        print(f"{name:24s} FAILED: {type(e).__name__}: {str(e)[:120]}")
+        return None
+
+
+r1 = bench("xla_gather", lambda s, i: s[i], src, idx)
+bench("xla_gather_sorted", lambda s, i: s[i], src, idx_sorted)
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BLK = 1 << 13
+
+
+def pk(src_ref, idx_ref, out_ref):
+    out_ref[:] = src_ref[idx_ref[:]]
+
+
+def pallas_gather(s, i):
+    return pl.pallas_call(
+        pk,
+        grid=(NI // BLK,),
+        in_specs=[pl.BlockSpec((NS,), lambda b: (0,),
+                               memory_space=pltpu.VMEM),
+                  pl.BlockSpec((BLK,), lambda b: (b,))],
+        out_specs=pl.BlockSpec((BLK,), lambda b: (b,)),
+        out_shape=jax.ShapeDtypeStruct((NI,), jnp.int32),
+    )(s, i)
+
+
+r3 = bench("pallas_vmem_gather", pallas_gather, src, idx)
+if r1 is not None and r3 is not None:
+    print("pallas correct:", bool(jnp.array_equal(r1, r3)))
+
+
+def pk_take(src_ref, idx_ref, out_ref):
+    out_ref[:] = jnp.take(src_ref[:], idx_ref[:], axis=0)
+
+
+def pallas_take(s, i):
+    return pl.pallas_call(
+        pk_take,
+        grid=(NI // BLK,),
+        in_specs=[pl.BlockSpec((NS,), lambda b: (0,),
+                               memory_space=pltpu.VMEM),
+                  pl.BlockSpec((BLK,), lambda b: (b,))],
+        out_specs=pl.BlockSpec((BLK,), lambda b: (b,)),
+        out_shape=jax.ShapeDtypeStruct((NI,), jnp.int32),
+    )(s, i)
+
+
+r4 = bench("pallas_take", pallas_take, src, idx)
+if r1 is not None and r4 is not None:
+    print("pallas_take correct:", bool(jnp.array_equal(r1, r4)))

@@ -1,0 +1,39 @@
+"""Why is the in-program gather 425ms when standalone is ~0? Probe variants."""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_enable_x64", True)
+
+NI, NS = 1 << 24, 1 << 20
+rng = np.random.default_rng(0)
+idx_np = rng.integers(0, NS, NI)
+idx32 = jnp.asarray(idx_np, jnp.int32)
+idx64 = jnp.asarray(idx_np, jnp.int64)
+src32 = jnp.asarray(rng.integers(0, 1 << 30, NS), jnp.int32)
+src64 = jnp.asarray(rng.integers(0, 1 << 60, NS), jnp.int64)
+
+
+def bench(name, fn, *args):
+    f = jax.jit(fn)
+    jax.block_until_ready(f(*args))
+    t0 = time.perf_counter()
+    for _ in range(3):
+        jax.block_until_ready(f(*args))
+    dt = (time.perf_counter() - t0) / 3 * 1000
+    print(f"{name:28s} {dt:8.2f} ms", flush=True)
+
+
+bench("i32src_i32idx", lambda s, i: s[i], src32, idx32)
+bench("i32src_i32idx_sum", lambda s, i: s[i].sum(), src32, idx32)
+bench("i64src_i32idx", lambda s, i: s[i], src64, idx32)
+bench("i32src_i64idx", lambda s, i: s[i], src32, idx64)
+bench("clip_then_gather", lambda s, i: s[jnp.clip(i, 0, NS - 1)], src32, idx32)
+bench("where_gather", lambda s, i: jnp.where(i < NS, s[jnp.clip(i, 0, NS-1)], 0), src32, idx32)
+# gather fused with producer of indices (cummax — the expand_join shape)
+from jax import lax
+bench("cummax_gather", lambda s, i: s[lax.cummax(i)], src32, idx32)
+# take with explicit mode
+bench("take_fill", lambda s, i: jnp.take(s, i, mode="fill"), src32, idx32)
+bench("take_clip", lambda s, i: jnp.take(s, i, mode="clip"), src32, idx32)

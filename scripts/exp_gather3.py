@@ -1,0 +1,53 @@
+"""When do 16M gathers become slow? Scale program complexity."""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+jax.config.update("jax_enable_x64", True)
+NI, NS = 1 << 24, 1 << 20
+rng = np.random.default_rng(0)
+idx = jnp.asarray(rng.integers(0, NS, NI), jnp.int32)
+srcs = [jnp.asarray(rng.integers(0, 1 << 30, NS), jnp.int32) for _ in range(10)]
+
+
+def bench(name, fn, *args):
+    f = jax.jit(fn)
+    jax.block_until_ready(f(*args))
+    t0 = time.perf_counter()
+    for _ in range(3):
+        jax.block_until_ready(f(*args))
+    print(f"{name:24s} {(time.perf_counter()-t0)/3*1000:8.1f} ms", flush=True)
+
+
+bench("gather1", lambda s, i: s[i].sum(), srcs[0], idx)
+bench("gather10_parallel", lambda i, *ss: sum(s[i].sum() for s in ss), idx, *srcs)
+
+
+def chained(s, i):
+    out = jnp.zeros((), jnp.int64)
+    for k in range(10):
+        g = s[(i + k) % NS]          # different idx each time
+        out = out + g.sum()
+    return out
+bench("gather10_chained", chained, srcs[0], idx)
+
+
+def sort_then_gather(s, i):
+    key, pos = lax.sort((i, jnp.arange(NI, dtype=jnp.int32)), num_keys=1)
+    g = s[key]
+    h = s[pos]
+    return g.sum() + h.sum()
+bench("sort_then_gather", sort_then_gather, srcs[0], idx)
+
+
+def join_like(s, i):
+    # mimic expand_join: scatter-max + cummax -> gather chain
+    starts = jnp.cumsum(jnp.ones(NI, jnp.int32)) - 1
+    marker = jnp.zeros(NI + 1, jnp.int32).at[starts].max(jnp.arange(NI, dtype=jnp.int32))
+    left = lax.cummax(marker[:NI])
+    g1 = s[jnp.clip(i[left], 0, NS - 1)]
+    g2 = s[jnp.clip(left % NS, 0, NS - 1)]
+    return g1.sum() + g2.sum()
+bench("join_like", join_like, srcs[0], idx)

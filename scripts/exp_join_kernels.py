@@ -1,0 +1,62 @@
+"""Microbench the sort-join kernel pieces at q95 scale on the TPU.
+
+Pieces (each its own jit; timed warm over 3 reps):
+  sort2      - lax.sort of (i32,i32), n
+  sort4      - lax.sort of 4 i32 operands, n
+  segsum     - segment_sum scatter, n data -> n segments (probe_counts path)
+  scatmax    - .at[idx].max scatter with a shared dump slot (expand_join path)
+  scatmax_u  - same but all-unique indices + unique_indices=True
+  cummax     - lax.cummax over n
+"""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+jax.config.update("jax_enable_x64", True)
+N = 1 << 21
+
+rng = np.random.default_rng(0)
+key = jnp.asarray(rng.integers(0, N, N), jnp.int32)
+iota = jnp.arange(N, dtype=jnp.int32)
+ones = jnp.ones(N, jnp.int32)
+starts = jnp.asarray(np.sort(rng.choice(2 * N, N, replace=False)), jnp.int32)
+has = jnp.asarray(rng.random(N) < 0.7)
+
+
+def sort2(k, i):
+    return lax.sort((k, i), num_keys=1, is_stable=True)[1]
+
+def sort4(k, i):
+    return lax.sort((k, i, k, i), num_keys=2, is_stable=True)[1]
+
+def segsum(d, g):
+    return jax.ops.segment_sum(d, g, num_segments=N)
+
+def scatmax(st, h, i):
+    idx = jnp.where(h, st, 2 * N)
+    m = jnp.zeros(2 * N + 1, jnp.int32).at[idx].max(i)
+    return lax.cummax(m[:2 * N])
+
+def scatmax_u(st, h, i):
+    idx = jnp.where(h, st, 2 * N + i)      # all unique
+    m = jnp.zeros(2 * N + N, jnp.int32).at[idx].max(i, unique_indices=True)
+    return lax.cummax(m[:2 * N])
+
+def cummax_(i):
+    return lax.cummax(i)
+
+
+CASES = [("sort2", sort2, (key, iota)), ("sort4", sort4, (key, iota)),
+         ("segsum", segsum, (ones, key)), ("scatmax", scatmax, (starts, has, iota)),
+         ("scatmax_u", scatmax_u, (starts, has, iota)), ("cummax", cummax_, (iota,))]
+
+for name, fn, args in CASES:
+    f = jax.jit(fn)
+    jax.block_until_ready(f(*args))
+    t0 = time.perf_counter()
+    for _ in range(3):
+        r = jax.block_until_ready(f(*args))
+    dt = (time.perf_counter() - t0) / 3 * 1000
+    print(f"{name:10s} {dt:8.1f} ms", flush=True)

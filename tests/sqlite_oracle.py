@@ -33,8 +33,12 @@ from nds_tpu.schema import get_schemas
 _AFFINITY = {"int": "INTEGER", "float": "REAL", "bool": "INTEGER",
              "date": "TEXT", "str": "TEXT"}
 
-_CAST_DATE = re.compile(r"CAST\s*\(\s*('([^']*)')\s+AS\s+DATE\s*\)",
-                        re.IGNORECASE)
+# strip CAST(... AS DATE) for literals AND identifiers: dates are ISO text
+# in the sqlite DB, and sqlite's CAST to the unknown DATE type applies
+# NUMERIC affinity ('1999-09-30' -> 1999), breaking date joins
+_CAST_DATE = re.compile(
+    r"CAST\s*\(\s*('[^']*'|[A-Za-z_][A-Za-z0-9_.]*)\s+AS\s+DATE\s*\)",
+    re.IGNORECASE)
 _CAST_DOUBLE = re.compile(r"AS\s+DOUBLE\s*\)", re.IGNORECASE)
 _INTERVAL = re.compile(
     r"('[^']*'|[A-Za-z_][A-Za-z0-9_.]*)\s*([+-])\s*INTERVAL\s+(\d+)\s+DAYS?",

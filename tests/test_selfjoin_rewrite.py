@@ -1,0 +1,113 @@
+"""q95-class self-join distinctness rewrite (planner._selfjoin_distinct_
+rewrite): `SELECT key FROM t a, t b WHERE a.key = b.key AND a.x <> b.x`
+consumed as a key set becomes `GROUP BY key HAVING MIN(x) < MAX(x)` —
+the pair expansion (the hottest buffer class on the chip, q95's 16M-row
+gathers spilling to host memory) disappears. Guard rails: the rewrite
+must NOT fire for multiplicity- or value-sensitive consumers."""
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import nds_tpu.engine.plan as P
+from nds_tpu.engine import Session
+from nds_tpu.engine.planner import Planner
+from nds_tpu.sql import parse_sql
+
+
+def _session():
+    rng = np.random.default_rng(11)
+    n = 5000
+    s = Session()
+    s.register_arrow("sales", pa.table({
+        "order_no": pa.array(rng.integers(0, 800, n), type=pa.int64()),
+        "wh": pa.array(rng.integers(0, 5, n), type=pa.int64()),
+        "amt": pa.array(rng.integers(1, 100, n), type=pa.int64()),
+    }))
+    s.register_arrow("probe", pa.table({
+        "o": pa.array(np.arange(800), type=pa.int64()),
+        "v": pa.array(np.arange(800) % 17, type=pa.int64()),
+    }))
+    return s
+
+
+MULTI_WH = """
+SELECT COUNT(*) FROM probe
+WHERE o IN (SELECT a.order_no FROM sales a, sales b
+            WHERE a.order_no = b.order_no AND a.wh <> b.wh)
+"""
+
+
+def _selfjoins(plan):
+    return [n for n in P.iter_plan_nodes(plan)
+            if isinstance(n, P.JoinNode) and isinstance(n.left, P.ScanNode)
+            and isinstance(n.right, P.ScanNode)
+            and n.left.table == n.right.table]
+
+
+def test_rewrite_fires_and_matches_literal():
+    s = _session()
+    plan = Planner(s._catalog()).plan_query(parse_sql(MULTI_WH))
+    assert not _selfjoins(plan), "self-join must be rewritten away"
+    got = s.sql(MULTI_WH, backend="numpy").to_pylist()
+    os.environ["NDS_TPU_NO_SELFJOIN_REWRITE"] = "1"
+    try:
+        s2 = _session()
+        plan2 = Planner(s2._catalog()).plan_query(parse_sql(MULTI_WH))
+        assert _selfjoins(plan2), "env toggle must disable the rewrite"
+        want = s2.sql(MULTI_WH, backend="numpy").to_pylist()
+    finally:
+        del os.environ["NDS_TPU_NO_SELFJOIN_REWRITE"]
+    assert got == want
+
+
+def test_rewrite_matches_on_device():
+    s = _session()
+    got = s.sql(MULTI_WH, backend="jax").to_pylist()
+    want = s.sql(MULTI_WH, backend="numpy").to_pylist()
+    assert got == want
+
+
+def test_no_rewrite_for_count_consumer():
+    """COUNT over the self-join sees pair multiplicities: must not fire."""
+    q = ("SELECT COUNT(*) FROM sales a, sales b "
+         "WHERE a.order_no = b.order_no AND a.wh <> b.wh")
+    s = _session()
+    plan = Planner(s._catalog()).plan_query(parse_sql(q))
+    assert _selfjoins(plan), "aggregate consumer observes multiplicity"
+    # and the answer is the true pair count on both backends
+    got = s.sql(q, backend="numpy").to_pylist()
+    got_j = s.sql(q, backend="jax").to_pylist()
+    assert got == got_j
+
+
+def test_no_rewrite_when_x_column_consumed():
+    """A consumer reading the wh column must keep the literal join."""
+    q = ("SELECT COUNT(*) FROM probe WHERE v IN "
+         "(SELECT a.wh FROM sales a, sales b "
+         " WHERE a.order_no = b.order_no AND a.wh <> b.wh)")
+    s = _session()
+    plan = Planner(s._catalog()).plan_query(parse_sql(q))
+    assert _selfjoins(plan), "wh is consumed: values matter, no rewrite"
+    got = s.sql(q, backend="numpy").to_pylist()
+    got_j = s.sql(q, backend="jax").to_pylist()
+    assert got == got_j
+
+
+def test_rewrite_handles_all_null_groups():
+    """Groups whose x is entirely NULL must not qualify (SQL <> is
+    null-rejecting), and single-row groups must not qualify."""
+    s = Session()
+    s.register_arrow("sales", pa.table({
+        "order_no": pa.array([1, 1, 2, 2, 3, 4, 4], type=pa.int64()),
+        "wh": pa.array([7, 8, None, None, 5, 6, 6], type=pa.int64()),
+        "amt": pa.array([1] * 7, type=pa.int64()),
+    }))
+    s.register_arrow("probe", pa.table({
+        "o": pa.array([1, 2, 3, 4], type=pa.int64()),
+        "v": pa.array([0, 0, 0, 0], type=pa.int64()),
+    }))
+    got = s.sql(MULTI_WH, backend="numpy").to_pylist()
+    # only order 1 has two distinct non-null wh values
+    assert list(map(tuple, got)) == [(1,)]

@@ -302,6 +302,24 @@ static int64_t rnd_date_sk(uint64_t r) {
     return SALES_SK_LO + (int64_t)(r % (uint64_t)(SALES_SK_HI - SALES_SK_LO + 1));
 }
 
+// Ticket/order numbers are CHRONOLOGICAL (real retail numbering; also how
+// a sequential OLTP source would emit them): the sold date is a monotone
+// map of the order id over the sales date range, plus a few days of
+// jitter. Date windows therefore correspond to contiguous ticket ranges,
+// which is what lets per-file ticket [min,max] manifest stats prune the
+// refresh deletes (warehouse file_stats; reference analog: Iceberg
+// per-file column metrics, nds/nds_maintenance.py:146-185). The marginal
+// date distribution stays uniform over the range.
+static int64_t chrono_date_sk(int64_t order, int64_t n_orders, uint64_t r) {
+    int64_t span = SALES_SK_HI - SALES_SK_LO + 1;
+    int64_t base = SALES_SK_LO +
+        (int64_t)(((__int128)order * span) / (n_orders > 0 ? n_orders : 1));
+    int64_t d = base + (int64_t)(r % 7) - 3;
+    if (d < SALES_SK_LO) d = SALES_SK_LO;
+    if (d > SALES_SK_HI) d = SALES_SK_HI;
+    return d;
+}
+
 // ---------------------------------------------------------------------------
 // dedicated dimension generators
 // ---------------------------------------------------------------------------
@@ -609,7 +627,8 @@ static int order_lines(uint64_t salt, int64_t order, int avg) {
     return 1 + (int)(rng_at(salt, 0x11, (uint64_t)order) % (uint64_t)(2 * avg - 1));
 }
 
-static SaleLine make_line(uint64_t salt, int64_t order, int line) {
+static SaleLine make_line(uint64_t salt, int64_t order, int line,
+                          int64_t n_orders) {
     SaleLine o;
     uint64_t ro = rng_at(salt, 0x22, (uint64_t)order);
     uint64_t rl = rng_at(salt, 0x33, (uint64_t)(order * 131 + line));
@@ -636,7 +655,7 @@ static SaleLine make_line(uint64_t salt, int64_t order, int line) {
     o.net_paid_ship = o.net_paid + o.ext_ship;
     o.net_paid_ship_tax = o.net_paid + o.ext_ship + o.ext_tax;
     o.net_profit = o.net_paid - o.ext_wholesale;
-    o.date_sk = rnd_date_sk(ro);
+    o.date_sk = chrono_date_sk(order, n_orders, mix64(ro + 11));
     o.time_sk = (int64_t)(mix64(ro + 1) % 86400);
     o.ship_date_sk = o.date_sk + 2 + (int64_t)(mix64(ro + 2) % 119);
     o.customer = 1 + (int64_t)(mix64(ro + 3) % (uint64_t)row_count("customer", g_scale));
@@ -864,7 +883,7 @@ static void generate_table(const char* name, double sf, int parallel,
         for (int64_t o = c.lo; o < c.hi; o++) {
             int nlines = order_lines(salt, o, avg);
             for (int ln = 0; ln < nlines; ln++) {
-                SaleLine s = make_line(salt, o, ln);
+                SaleLine s = make_line(salt, o, ln, orders);
                 if (is_ss) gen_store_sales_row(salt, s, L, f);
                 else gen_channel_sales_row(*t, salt, s, L, f);
             }
@@ -880,7 +899,7 @@ static void generate_table(const char* name, double sf, int parallel,
         for (int64_t o = c.lo; o < c.hi; o++) {
             int nlines = order_lines(ssalt, o, avg);
             for (int ln = 0; ln < nlines; ln++) {
-                SaleLine s = make_line(ssalt, o, ln);
+                SaleLine s = make_line(ssalt, o, ln, orders);
                 if (!s.returned) continue;
                 if (is_sr) gen_store_returns_row(salt, s, L, f);
                 else gen_channel_returns_row(*t, salt, s, L, f);

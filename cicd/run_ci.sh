@@ -3,10 +3,11 @@
 # cicd/ci.yml, runnable locally: `bash cicd/run_ci.sh all`).
 #
 # Stages:
-#   native  - build the C++ data generator and self-check one tiny table
-#   test    - full pytest suite on an 8-virtual-device CPU mesh
-#   bench   - quick bench slice (SF 0.01) to catch perf regressions early
-#   all     - every stage in order
+#   native     - build the C++ data generator and self-check one tiny table
+#   resilience - fast smoke of the fault-injection/retry/deadline layer
+#   test       - full pytest suite on an 8-virtual-device CPU mesh
+#   bench      - quick bench slice (SF 0.01) to catch perf regressions early
+#   all        - every stage in order
 set -euo pipefail
 
 REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
@@ -29,6 +30,13 @@ stage_native() {
     echo "native OK"
 }
 
+stage_resilience() {
+    # fast smoke of the resilience layer: supervised streams, per-query
+    # deadlines, resume-from-log, and the engine fault registry — these
+    # guard the multi-hour runs, so they gate early and cheaply
+    (cd "$REPO" && python -m pytest tests/test_resilience.py -q)
+}
+
 stage_test() {
     (cd "$REPO" && python -m pytest tests/ -q --durations=15)
 }
@@ -42,10 +50,12 @@ stage_bench() {
 }
 
 case "${1:-all}" in
-    native) stage_native ;;
-    test)   stage_test ;;
-    bench)  stage_bench ;;
-    all)    stage_native; stage_test; stage_bench ;;
-    --list) echo "native test bench all" ;;
-    *) echo "usage: run_ci.sh [native|test|bench|all|--list]" >&2; exit 2 ;;
+    native)     stage_native ;;
+    resilience) stage_resilience ;;
+    test)       stage_test ;;
+    bench)      stage_bench ;;
+    all)        stage_native; stage_resilience; stage_test; stage_bench ;;
+    --list)     echo "native resilience test bench all" ;;
+    *) echo "usage: run_ci.sh [native|resilience|test|bench|all|--list]" >&2
+       exit 2 ;;
 esac

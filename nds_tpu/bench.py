@@ -27,6 +27,7 @@ import yaml
 
 from . import datagen, maintenance, streams, transcode
 from .power import run_query_stream
+from .resilience import RetryPolicy
 from .throughput import run_throughput, stream_log_path, throughput_elapsed
 
 
@@ -60,6 +61,7 @@ def get_load_time(report_path: str) -> float:
 
 def get_load_end_timestamp(report_path: str) -> int:
     """RNGSEED scraped from the load report (nds_bench.py:60-76)."""
+    _require_report(report_path, "load_test")
     with open(report_path) as f:
         for line in f:
             if line.startswith("RNGSEED used:"):
@@ -199,7 +201,16 @@ def run_full_bench(cfg: dict) -> dict:
                          warmup=int(power_cfg.get("warmup", 0)))
     t_power = get_power_time(power_log)
 
-    # steps 4+6: throughput rounds; steps 5+7: maintenance rounds
+    # steps 4+6: throughput rounds; steps 5+7: maintenance rounds.
+    # Phase-level retry (resilience: {phase_attempts: N, phase_backoff_s}):
+    # a round that fails transiently — a permanently failed stream, a
+    # dropped device tunnel — re-runs whole up to N times with backoff
+    # before the bench aborts. Stream logs are rewritten per attempt, so a
+    # retried round scrapes only its own successful run.
+    res_cfg = cfg.get("resilience", {})
+    phase_policy = RetryPolicy(
+        max_attempts=max(1, int(res_cfg.get("phase_attempts", 1))),
+        backoff_s=float(res_cfg.get("phase_backoff_s", 1.0)))
     tt_cfg = cfg.get("throughput_test", {})
     dm_cfg = cfg.get("maintenance_test", {})
     t_tt: dict[int, float] = {}
@@ -207,12 +218,16 @@ def run_full_bench(cfg: dict) -> dict:
     for rnd in (1, 2):
         ids = get_stream_range(num_streams, rnd)
         if not _skip(tt_cfg):
-            run_throughput(warehouse, stream_dir, ids, report_dir,
-                           input_format=input_format,
-                           sub_queries=sub_queries, backend=backend,
-                           mode=tt_cfg.get("mode", "process"),
-                           warmup=int(tt_cfg.get("warmup", 0)),
-                           decimal=decimal)
+            phase_policy.call(
+                run_throughput, warehouse, stream_dir, ids, report_dir,
+                label=f"throughput round {rnd}",
+                input_format=input_format,
+                sub_queries=sub_queries, backend=backend,
+                mode=tt_cfg.get("mode", "process"),
+                warmup=int(tt_cfg.get("warmup", 0)),
+                decimal=decimal,
+                max_attempts=tt_cfg.get("stream_attempts"),
+                stream_timeout=tt_cfg.get("stream_timeout"))
         tt_logs = [stream_log_path(report_dir, s) for s in ids]
         for lg in tt_logs:
             _require_report(lg, "throughput_test")
@@ -221,8 +236,10 @@ def run_full_bench(cfg: dict) -> dict:
         for s in ids:
             dm_log = os.path.join(report_dir, f"maintenance_{s}.csv")
             if not _skip(dm_cfg):
-                maintenance.run_maintenance(
+                phase_policy.call(
+                    maintenance.run_maintenance,
                     warehouse, _refresh_dir(data_path, s), dm_log,
+                    label=f"maintenance stream {s}",
                     backend=backend, decimal=decimal)
             dm_total += get_maintenance_time(dm_log)
         t_dm[rnd] = dm_total

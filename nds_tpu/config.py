@@ -75,6 +75,25 @@ class EngineConfig:
     # from the warehouse; here eviction forces a re-upload on next use).
     # 0 disables eviction.
     scan_budget_gb: float = 10.0
+    # -- resilience (nds_tpu/resilience.py) --------------------------------
+    # per-query wall-clock budget in seconds; an overrun abandons the query
+    # and records Failed (DeadlineExceeded). 0 = unbounded.
+    query_timeout_s: float = 0.0
+    # timed attempts per query: transient failures retry with exponential
+    # backoff before the query records Failed. 1 = no retry.
+    query_attempts: int = 1
+    # base backoff between retry attempts (doubles per attempt, capped)
+    retry_backoff_s: float = 0.1
+    # per-stream wall-clock budget for the throughput supervisor; a stream
+    # past it is killed (process mode) or abandoned (thread mode). 0 = none.
+    stream_timeout_s: float = 0.0
+    # spawn attempts per throughput stream (crash/timeout => restart with
+    # backoff until exhausted). 1 = no restart.
+    stream_attempts: int = 1
+    # armed fault-injection specs, e.g. ("jax.execute:hang:5#1",
+    # "arrow.read:raise@0.1") — see resilience.FaultSpec for the grammar;
+    # property file: nds.tpu.fault_points=point:action,point:action
+    fault_points: tuple[str, ...] = ()
 
     @staticmethod
     def from_property_file(path: str | None) -> "EngineConfig":
@@ -88,6 +107,8 @@ class EngineConfig:
                 setattr(cfg, key, v.lower() in ("1", "true", "yes"))
             elif isinstance(cur, int):
                 setattr(cfg, key, int(v))
+            elif isinstance(cur, float):
+                setattr(cfg, key, float(v))
             elif isinstance(cur, str):
                 setattr(cfg, key, v)
             elif isinstance(cur, tuple):

@@ -114,6 +114,8 @@ def from_arrow_column(arr, dec_as_int: bool = False) -> Column:
 
 
 def from_arrow(table: pa.Table, dec_as_int: bool = False) -> Table:
+    from ..resilience import FAULTS
+    FAULTS.fire("arrow.read")
     return Table(list(table.schema.names),
                  [from_arrow_column(table.column(i), dec_as_int)
                   for i in range(table.num_columns)])

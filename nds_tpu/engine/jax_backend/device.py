@@ -127,6 +127,8 @@ jax.tree_util.register_pytree_node(DTable, _dtable_flatten, _dtable_unflatten)
 
 def to_device(table: Table, capacity: Optional[int] = None,
               device=None) -> DTable:
+    from ...resilience import FAULTS
+    FAULTS.fire("device.put")
     n = table.num_rows
     cap = capacity if capacity is not None else bucket(n)
 

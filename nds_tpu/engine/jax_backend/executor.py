@@ -155,6 +155,9 @@ class CompiledQuery:
         out over a thread pool (one compile per segment/query at once
         instead of serial-at-first-execution)."""
         import time as _time
+
+        from ...resilience import FAULTS
+        FAULTS.fire("jax.compile")
         if self._fn is None:
             self._fn = jax.jit(self._trace)
         params = tuple(jax.ShapeDtypeStruct((), phys_dtype(d))
@@ -169,9 +172,12 @@ class CompiledQuery:
             keep_device: bool = False) -> DTable:
         import time as _time
 
+        from ...resilience import FAULTS
         first = self._fn is None
         if first:
+            FAULTS.fire("jax.compile")
             self._fn = jax.jit(self._trace)
+        FAULTS.fire("jax.execute")
         t1 = _time.perf_counter()
         if self._aot is not None:
             try:
@@ -765,6 +771,8 @@ class JaxExecutor:
         (plan-traversal order, stream-invariant) — sorting would let
         stream-specific segment fingerprints permute the compiled
         program's argument order and break cross-stream HLO identity."""
+        from ...resilience import FAULTS
+        FAULTS.fire("jax.execute")
         rec = _Recorder("record")
         self._rec = rec
         self._touched_scans = {}

@@ -35,6 +35,12 @@ def _and_conjuncts(node):
 class Session:
     def __init__(self, config: Optional[EngineConfig] = None):
         self.config = config or EngineConfig()
+        if self.config.fault_points:
+            # arm the engine-level fault registry from config/property file
+            # (nds.tpu.fault_points=point:action,...): the resilience layer's
+            # injectable failures — see nds_tpu/resilience.py
+            from ..resilience import FAULTS
+            FAULTS.configure(self.config.fault_points)
         self.warehouse = None  # attached via attach_warehouse for DML
         self._loaders: dict[str, Callable[[], Table]] = {}
         self._schemas: dict[str, tuple[list[str], list[str]]] = {}

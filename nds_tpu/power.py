@@ -23,6 +23,7 @@ from collections import OrderedDict
 from .engine import Session
 from .config import EngineConfig
 from .report import BenchReport
+from .resilience import FAULTS, FaultSpec, RetryPolicy, run_with_deadline
 from .schema import get_maintenance_schemas, get_schemas
 from .streams import SPECIAL_TEMPLATES, split_special_query
 
@@ -159,7 +160,10 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
                      fault_inject: list[str] | None = None,
                      keep_sc: bool = False,
                      decimal: str | None = None,
-                     precompile: bool = True) -> list[tuple[str, int, int, int]]:
+                     precompile: bool = True,
+                     query_timeout: float | None = None,
+                     query_attempts: int | None = None,
+                     resume: bool = False) -> list[tuple[str, int, int, int]]:
     """Run every query in the stream; returns (name, start_ms, end_ms, ms).
 
     The CSV time log layout (query name, start, end, elapsed + the
@@ -173,10 +177,22 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
     profile_folder: write a jax.profiler trace per query under this folder
     (the Spark-UI job-group analog, reference nds_power.py:254).
     fault_inject: query names whose timed run raises an injected fault —
-    a harness-testing hook (SURVEY.md §5 failure-detection item; the
-    reference only detects failures, it cannot inject them): the run must
-    record ``Failed`` with the exception in the JSON summary and keep
-    going, exactly like a genuine mid-stream query failure.
+    sugar over the resilience FaultRegistry (``query.run`` raise-specs;
+    SURVEY.md §5 failure-detection item; the reference only detects
+    failures, it cannot inject them): the run must record ``Failed`` with
+    the exception in the JSON summary and keep going, exactly like a
+    genuine mid-stream query failure. Arbitrary engine-level faults arm
+    via EngineConfig.fault_points / nds.tpu.fault_points instead.
+    query_timeout: per-query wall-clock budget in seconds (None = take
+    EngineConfig.query_timeout_s; 0 = unbounded). An overrun abandons the
+    query mid-flight and records ``Failed`` (DeadlineExceeded) — a hung
+    device call cannot stall the stream.
+    query_attempts: timed attempts per query (None = take
+    EngineConfig.query_attempts): transient failures retry with
+    deterministic backoff; per-attempt statuses land in the JSON summary.
+    resume: skip queries already recorded in an existing (flushed partial)
+    time log — a multi-hour stream interrupted mid-run restarts where it
+    stopped, keeping the original Power Start Time.
     """
     from .check import check_json_summary_folder, check_query_subset_exists
     from .config import maybe_enable_compile_cache
@@ -198,97 +214,167 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
             if k in sub_queries
             or re.sub(r"_part[12]$", "", k) in sub_queries)
 
+    timeout_s = config.query_timeout_s if query_timeout is None \
+        else query_timeout
+    attempts = config.query_attempts if query_attempts is None \
+        else query_attempts
+    retry = RetryPolicy(max_attempts=attempts,
+                        backoff_s=config.retry_backoff_s) \
+        if attempts and attempts > 1 else None
+
     rows: list[tuple[str, int, int, int]] = []
+    done: set[str] = set()
+    resumed_start: int | None = None
+    resumed_end: int | None = None
+    if resume and os.path.exists(time_log):
+        rows, resumed_start, resumed_end = _read_partial_log(time_log)
+        done = {r[0] for r in rows}
+        if done:
+            print(f"resume: {len(done)} queries already recorded in "
+                  f"{time_log}; skipping them", flush=True)
+
     fallback_queries: dict[str, list[str]] = {}
-    inject = set(fault_inject or ())
+    armed = [FAULTS.arm(FaultSpec(point="query.run", match=n))
+             for n in (fault_inject or ())]
 
     def _injected(name: str) -> bool:
-        return name in inject or re.sub(r"_part[12]$", "", name) in inject
+        base = re.sub(r"_part[12]$", "", name)
+        return FAULTS.would_raise("query.run", name, aliases=(base,))
 
-    # phase-structured cold start (warmup >= 1): record EVERY query once,
-    # then compile all recorded programs through the tunnel CONCURRENTLY
-    # (JaxExecutor.precompile_parallel) instead of serial-at-second-run.
-    # The reference's analog is Spark planning at ~ms per query
-    # (nds_power.py:124-134); here parallel compile RPCs turn a cold
-    # stream's wall clock from sum(compiles) into ~max(compiles).
-    eff_warmup = warmup
-    failed_records: set[str] = set()
-    use_jax = (backend == "jax") if backend else config.use_jax
-    if precompile and warmup >= 1 and use_jax:
-        t0 = time.perf_counter()
-        for name, sql in query_dict.items():
-            if _injected(name):
-                continue
-            try:
-                run_one_query(session, sql, name, None, output_format,
-                              backend)
-            except Exception:
-                # possibly transient: give this query its full per-query
-                # warmup back so the timed run is not a first-sighting
-                # eager outlier
-                failed_records.add(name)
-                continue
-        t1 = time.perf_counter()
-        res = session._jax_executor().precompile_parallel()
-        done = sum(1 for v in res.values() if v == "compiled")
-        recorded = sum(1 for n in query_dict
-                       if not _injected(n) and n not in failed_records)
-        print(f"precompile: recorded {recorded} queries in "
-              f"{t1 - t0:.1f}s; compiled {done}/{len(res)} programs in "
-              f"{time.perf_counter() - t1:.1f}s", flush=True)
-        eff_warmup = warmup - 1
-
-    power_start = int(time.time() * 1000)
-    for name, sql in query_dict.items():
-        report = BenchReport(config, app_name=f"NDS-TPU {name}")
-        injected = _injected(name)
-        if injected:
-            session.last_fallbacks = []     # injected runs never reach the
-            session.last_exec_stats = {}    # session; don't report stale state
-            def run_fn(*_a, **_k):
-                raise RuntimeError(f"injected fault for {name}")
-        else:
-            run_fn = run_one_query
-            for _ in range(warmup if name in failed_records else eff_warmup):
+    try:
+        # phase-structured cold start (warmup >= 1): record EVERY query
+        # once, then compile all recorded programs through the tunnel
+        # CONCURRENTLY (JaxExecutor.precompile_parallel) instead of
+        # serial-at-second-run. The reference's analog is Spark planning at
+        # ~ms per query (nds_power.py:124-134); here parallel compile RPCs
+        # turn a cold stream's wall clock from sum(compiles) into
+        # ~max(compiles).
+        eff_warmup = warmup
+        failed_records: set[str] = set()
+        use_jax = (backend == "jax") if backend else config.use_jax
+        if precompile and warmup >= 1 and use_jax:
+            t0 = time.perf_counter()
+            for name, sql in query_dict.items():
+                if _injected(name) or name in done:
+                    continue
                 try:
                     run_one_query(session, sql, name, None, output_format,
                                   backend)
                 except Exception:
-                    break  # the timed run reports the failure
-        q_start = int(time.time() * 1000)
-        if profile_folder:
-            import jax
-            os.makedirs(profile_folder, exist_ok=True)
-            with jax.profiler.trace(os.path.join(profile_folder, name)):
-                report.report_on(run_fn, session, sql, name,
-                                 output_prefix, output_format, backend)
-        else:
-            report.report_on(run_fn, session, sql, name,
-                             output_prefix, output_format, backend)
-        for fb in session.last_fallbacks:
-            report.record_task_failure(f"device fallback: {fb}")
-        if session.last_fallbacks:
-            fallback_queries[name] = list(session.last_fallbacks)
-        if session.last_exec_stats:
-            report.record_exec_stats(session.last_exec_stats)
-        elapsed = report.summary["queryTimes"][-1]
-        rows.append((name, q_start, q_start + elapsed, elapsed))
-        status = report.finalize_status()
-        print(f"{name}: {status} in {elapsed} ms", flush=True)
-        if json_summary_folder:
-            report.write_summary(
-                name, prefix=os.path.join(json_summary_folder, "power"))
-        # flush the partial log after every query: a multi-hour stream
-        # interrupted mid-run keeps its measurements (sentinel rows are
-        # appended only by the completed run below)
-        _write_time_log(time_log, power_start, rows, None)
-    power_end = int(time.time() * 1000)
-    _write_time_log(time_log, power_start, rows, power_end)
+                    # possibly transient: give this query its full
+                    # per-query warmup back so the timed run is not a
+                    # first-sighting eager outlier
+                    failed_records.add(name)
+                    continue
+            t1 = time.perf_counter()
+            res = session._jax_executor().precompile_parallel()
+            compiled = sum(1 for v in res.values() if v == "compiled")
+            recorded = sum(1 for n in query_dict
+                           if not _injected(n) and n not in failed_records
+                           and n not in done)
+            print(f"precompile: recorded {recorded} queries in "
+                  f"{t1 - t0:.1f}s; compiled {compiled}/{len(res)} programs "
+                  f"in {time.perf_counter() - t1:.1f}s", flush=True)
+            eff_warmup = warmup - 1
+
+        power_start = resumed_start if resumed_start is not None \
+            else int(time.time() * 1000)
+        executed = 0
+        for name, sql in query_dict.items():
+            if name in done:
+                continue
+            executed += 1
+            report = BenchReport(config, app_name=f"NDS-TPU {name}")
+            base = re.sub(r"_part[12]$", "", name)
+            # a failed/injected/timed-out run never reaches the session;
+            # clear observability state so the report isn't stale
+            session.last_fallbacks = []
+            session.last_exec_stats = {}
+
+            def run_fn(*a, _name=name, _base=base, **k):
+                FAULTS.fire("query.run", _name, aliases=(_base,))
+                return run_one_query(*a, **k)
+
+            def attempt_fn(*a, _name=name, **k):
+                return run_with_deadline(run_fn, timeout_s, *a,
+                                         label=_name, **k)
+
+            if not _injected(name):
+                for _ in range(warmup if name in failed_records
+                               else eff_warmup):
+                    try:
+                        run_one_query(session, sql, name, None,
+                                      output_format, backend)
+                    except Exception:
+                        break  # the timed run reports the failure
+            q_start = int(time.time() * 1000)
+            if profile_folder:
+                import jax
+                os.makedirs(profile_folder, exist_ok=True)
+                with jax.profiler.trace(os.path.join(profile_folder, name)):
+                    report.report_on(attempt_fn, session, sql, name,
+                                     output_prefix, output_format, backend,
+                                     retry=retry)
+            else:
+                report.report_on(attempt_fn, session, sql, name,
+                                 output_prefix, output_format, backend,
+                                 retry=retry)
+            for fb in session.last_fallbacks:
+                report.record_task_failure(f"device fallback: {fb}")
+            if session.last_fallbacks:
+                fallback_queries[name] = list(session.last_fallbacks)
+            if session.last_exec_stats:
+                report.record_exec_stats(session.last_exec_stats)
+            elapsed = report.summary["queryTimes"][-1]
+            rows.append((name, q_start, q_start + elapsed, elapsed))
+            status = report.finalize_status()
+            print(f"{name}: {status} in {elapsed} ms", flush=True)
+            if json_summary_folder:
+                report.write_summary(
+                    name, prefix=os.path.join(json_summary_folder, "power"))
+            # flush the partial log after every query: a multi-hour stream
+            # interrupted mid-run keeps its measurements (sentinel rows are
+            # appended only by the completed run below), and --resume
+            # restarts from exactly this flushed state
+            _write_time_log(time_log, power_start, rows, None)
+        # resuming an already-complete log with nothing left to run keeps
+        # the original Power End Time (rewriting it would inflate the
+        # recorded Power Test Time)
+        power_end = resumed_end if (executed == 0 and resumed_end is not None) \
+            else int(time.time() * 1000)
+        _write_time_log(time_log, power_start, rows, power_end)
+    finally:
+        for s in armed:
+            FAULTS.disarm(s)
     if strict and fallback_queries:
         raise RuntimeError(
             "device fallbacks in strict mode: " + "; ".join(
                 f"{q}: {fbs}" for q, fbs in fallback_queries.items()))
     return rows
+
+
+def _read_partial_log(time_log: str) -> tuple[list, int | None, int | None]:
+    """Parse a (possibly partial) power time log written by
+    _write_time_log: per-query rows plus the Power Start/End sentinels
+    (End present only if the run completed). The atomic
+    flush-after-every-query contract means any existing log is a
+    consistent prefix of the run — exactly what --resume needs."""
+    rows: list[tuple[str, int, int, int]] = []
+    power_start: int | None = None
+    power_end: int | None = None
+    with open(time_log) as f:
+        for row in csv.reader(f):
+            if not row or row[0] == "query":
+                continue
+            if row[0] == "Power Start Time":
+                power_start = int(row[1])
+            elif row[0] == "Power End Time":
+                power_end = int(row[1])
+            elif row[0] == "Power Test Time":
+                continue
+            else:
+                rows.append((row[0], int(row[1]), int(row[2]), int(row[3])))
+    return rows, power_start, power_end
 
 
 def _write_time_log(time_log: str, power_start: int, rows, power_end) -> None:
@@ -335,6 +421,17 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--no_precompile", action="store_true",
                    help="disable the record-all-then-compile-parallel cold "
                         "start (compiles lazily at second execution)")
+    p.add_argument("--query_timeout", type=float, default=None,
+                   help="per-query wall-clock budget in seconds (overrun "
+                        "records Failed and the stream continues); default "
+                        "from nds.tpu.query_timeout_s, 0 = unbounded")
+    p.add_argument("--retry", type=int, default=None,
+                   help="timed attempts per query (transient failures "
+                        "retry with backoff); default from "
+                        "nds.tpu.query_attempts")
+    p.add_argument("--resume", action="store_true",
+                   help="skip queries already recorded in the existing "
+                        "(partial) time log and keep its Power Start Time")
     a = p.parse_args(argv)
     sub = a.sub_queries.split(",") if a.sub_queries else None
     inject = a.fault_inject.split(",") if a.fault_inject else None
@@ -343,7 +440,9 @@ def main(argv: list[str] | None = None) -> int:
                      a.json_summary_folder, sub, a.property_file, a.backend,
                      warmup=a.warmup, strict=a.strict,
                      profile_folder=a.profile_folder, fault_inject=inject,
-                     decimal=a.decimal, precompile=not a.no_precompile)
+                     decimal=a.decimal, precompile=not a.no_precompile,
+                     query_timeout=a.query_timeout, query_attempts=a.retry,
+                     resume=a.resume)
     return 0
 
 

@@ -52,25 +52,53 @@ class BenchReport:
             "startTime": None,
             "queryTimes": [],
             "taskFailures": [],
+            # per-attempt records (resilience layer): attempts consumed per
+            # report_on call, and the per-attempt status trail — a query
+            # that failed transiently then completed reads
+            # attempts=[2], retriedStatus=[["Failed", "Completed"]]
+            "attempts": [],
+            "retriedStatus": [],
         }
 
-    def report_on(self, fn: Callable, *args, **kwargs):
+    def report_on(self, fn: Callable, *args, retry=None, **kwargs):
         """Run fn, recording wall time and status. Returns fn's result
-        (or None on failure)."""
+        (or None on failure).
+
+        retry: an optional resilience.RetryPolicy — transient failures
+        re-run fn with deterministic backoff; every attempt's status lands
+        in the summary (``attempts``/``retriedStatus``), and a retried-
+        then-successful query records each failed attempt as a task
+        failure, so finalize_status upgrades it to
+        CompletedWithTaskFailures instead of a clean Completed.
+        """
         self.summary["startTime"] = int(time.time() * 1000)
         start = time.perf_counter()
         result = None
-        try:
-            result = fn(*args, **kwargs)
-            status = "Completed"
-        except Exception:
-            status = "Failed"
-            self.summary["exceptions"].append(traceback.format_exc())
+        attempt_trail: list[str] = []
+        while True:
+            try:
+                result = fn(*args, **kwargs)
+                status = "Completed"
+                attempt_trail.append(status)
+                break
+            except Exception as e:
+                status = "Failed"
+                attempt_trail.append(status)
+                self.summary["exceptions"].append(traceback.format_exc())
+                if retry is None or len(attempt_trail) >= retry.max_attempts \
+                        or retry.classify(e) == "fatal":
+                    break
+                self.record_task_failure(
+                    f"attempt {len(attempt_trail)} failed "
+                    f"({type(e).__name__}); retrying")
+                time.sleep(retry.backoff(len(attempt_trail)))
         elapsed = int((time.perf_counter() - start) * 1000)
         if status == "Completed" and self.summary["taskFailures"]:
             status = "CompletedWithTaskFailures"
         self.summary["queryStatus"].append(status)
         self.summary["queryTimes"].append(elapsed)
+        self.summary["attempts"].append(len(attempt_trail))
+        self.summary["retriedStatus"].append(attempt_trail)
         return result
 
     def record_task_failure(self, detail: str) -> None:

@@ -1,0 +1,355 @@
+"""Resilience layer: retry policies, deadlines, and fault injection.
+
+The NDS lifecycle runs for hours at real scale factors, and the reference
+harness's only answer to failure is detection (record ``Failed`` in the
+JSON summary and keep the stream going). Production SQL engines treat
+query-level fault tolerance and bounded execution as table stakes; this
+module supplies the primitives the runners build on:
+
+- :class:`RetryPolicy` — deterministic exponential backoff with a
+  transient/fatal exception classification, used by ``report.BenchReport``
+  for per-query attempts and by ``bench`` for phase-level retry.
+- :class:`Deadline` / :func:`run_with_deadline` — wall-clock budgets for a
+  query or a stream; a budget overrun raises :class:`DeadlineExceeded`
+  (the worker thread is abandoned, not killed — the caller records the
+  failure and moves on).
+- :class:`FaultRegistry` — named engine-level fault points
+  (``arrow.read``, ``device.put``, ``jax.compile``, ``jax.execute``,
+  ``stream.spawn``, ``query.run``) threaded through the engine and
+  harness, armable to raise, delay, or hang at a given point/probability.
+  This generalizes the ad-hoc ``--fault_inject`` query list the power
+  runner grew (now sugar over ``query.run`` specs) and lets the retry /
+  deadline / restart machinery be tested without a flaky device.
+
+Everything here is deterministic: backoff schedules are pure functions of
+the attempt number, and probabilistic fault draws come from a registry-
+seeded RNG, so a failing run replays identically.
+"""
+from __future__ import annotations
+
+import atexit
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Optional
+
+
+class FaultError(RuntimeError):
+    """Raised by an armed fault point (a deliberately injected failure)."""
+
+
+class TransientError(RuntimeError):
+    """Base class for errors a RetryPolicy treats as retryable."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """A per-query or per-stream wall-clock budget expired."""
+
+
+# -- retry --------------------------------------------------------------------
+
+#: exception type names (searched over the whole MRO) retried by default.
+#: JaxRuntimeError covers tunnel drops / remote-compile hiccups without
+#: importing jax here; FaultError is transient by design (injected faults
+#: simulate transient infrastructure failures unless armed to repeat).
+_TRANSIENT_NAMES = ("TransientError", "FaultError", "JaxRuntimeError",
+                    "ConnectionError", "TimeoutError", "BrokenPipeError")
+#: never retried: a blown deadline already consumed its budget, and
+#: interrupts must propagate.
+_FATAL_NAMES = ("DeadlineExceeded", "KeyboardInterrupt", "SystemExit")
+
+
+@dataclass
+class RetryPolicy:
+    """Deterministic bounded retry: ``max_attempts`` tries, exponential
+    backoff ``backoff_s * factor**(attempt-1)`` capped at ``max_backoff_s``.
+    """
+    max_attempts: int = 3
+    backoff_s: float = 0.1
+    backoff_factor: float = 2.0
+    max_backoff_s: float = 30.0
+    transient_names: tuple = _TRANSIENT_NAMES
+    fatal_names: tuple = _FATAL_NAMES
+
+    def classify(self, exc: BaseException) -> str:
+        """"transient" (retryable) or "fatal". Fatal wins on conflict;
+        unknown exception types default to transient — a mid-stream query
+        failure is worth one more try, and the attempt bound caps the cost.
+        """
+        names = {c.__name__ for c in type(exc).__mro__}
+        if names & set(self.fatal_names):
+            return "fatal"
+        if names & set(self.transient_names):
+            return "transient"
+        return "transient"
+
+    def backoff(self, attempt: int) -> float:
+        """Seconds to wait after failed attempt `attempt` (1-based)."""
+        return min(self.max_backoff_s,
+                   self.backoff_s * self.backoff_factor ** (attempt - 1))
+
+    def call(self, fn: Callable, *args, label: str = "",
+             sleep: Callable[[float], None] = time.sleep,
+             on_attempt: Optional[Callable] = None, **kwargs):
+        """Run ``fn`` under this policy; re-raises the last error when
+        attempts are exhausted or the error classifies fatal. ``on_attempt``
+        (attempt#, exception|None) observes every try."""
+        for attempt in range(1, self.max_attempts + 1):
+            try:
+                out = fn(*args, **kwargs)
+                if on_attempt is not None:
+                    on_attempt(attempt, None)
+                return out
+            except Exception as e:
+                if on_attempt is not None:
+                    on_attempt(attempt, e)
+                if attempt >= self.max_attempts or \
+                        self.classify(e) == "fatal":
+                    raise
+                sleep(self.backoff(attempt))
+
+
+# -- deadlines ----------------------------------------------------------------
+
+class Deadline:
+    """A wall-clock budget. ``seconds=None`` (or <= 0) never expires."""
+
+    def __init__(self, seconds: Optional[float],
+                 clock: Callable[[], float] = time.monotonic):
+        self.seconds = seconds if seconds and seconds > 0 else None
+        self._clock = clock
+        self._t0 = clock()
+
+    def remaining(self) -> Optional[float]:
+        if self.seconds is None:
+            return None
+        return self.seconds - (self._clock() - self._t0)
+
+    def expired(self) -> bool:
+        rem = self.remaining()
+        return rem is not None and rem <= 0
+
+    def check(self, label: str = "") -> None:
+        if self.expired():
+            raise DeadlineExceeded(
+                f"{label or 'deadline'} exceeded {self.seconds}s budget")
+
+
+#: deadline workers abandoned mid-flight, drained (bounded) at exit: a
+#: daemon thread killed while inside XLA compute aborts interpreter
+#: teardown (std::terminate from the C++ runtime), turning an otherwise
+#: clean run into a spurious nonzero exit the stream supervisor would
+#: retry. Truly hung workers still abandon after the grace.
+_ABANDONED: list[threading.Thread] = []
+_ABANDONED_LOCK = threading.Lock()
+
+
+def _drain_abandoned(grace_s: Optional[float] = None) -> None:
+    grace = float(os.environ.get("NDS_TPU_DEADLINE_DRAIN_S", "10")) \
+        if grace_s is None else grace_s
+    until = time.monotonic() + grace
+    with _ABANDONED_LOCK:
+        workers = list(_ABANDONED)
+        _ABANDONED.clear()
+    for t in workers:
+        t.join(max(0.0, until - time.monotonic()))
+
+
+atexit.register(_drain_abandoned)
+
+
+def run_with_deadline(fn: Callable, timeout_s: Optional[float], *args,
+                      label: str = "", **kwargs):
+    """Run ``fn`` bounded by ``timeout_s`` wall seconds.
+
+    The call runs in a daemon worker thread; on overrun the worker is
+    ABANDONED (python threads cannot be killed) and DeadlineExceeded
+    raises in the caller, which records the failure and continues — the
+    same containment posture the reference gets from per-app process
+    isolation. Abandoned workers get a bounded join at interpreter exit
+    (NDS_TPU_DEADLINE_DRAIN_S, default 10) so a worker still inside XLA
+    doesn't abort teardown. timeout_s None/<=0 calls ``fn`` inline.
+    """
+    if not timeout_s or timeout_s <= 0:
+        return fn(*args, **kwargs)
+    box: dict = {}
+
+    def work():
+        try:
+            box["result"] = fn(*args, **kwargs)
+        except BaseException as e:      # delivered to the caller below
+            box["error"] = e
+
+    t = threading.Thread(target=work, daemon=True,
+                         name=f"deadline-worker:{label or fn.__name__}")
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        with _ABANDONED_LOCK:
+            _ABANDONED[:] = [w for w in _ABANDONED if w.is_alive()]
+            _ABANDONED.append(t)
+        raise DeadlineExceeded(
+            f"{label or 'call'} exceeded {timeout_s}s budget "
+            "(worker abandoned)")
+    if "error" in box:
+        raise box["error"]
+    return box.get("result")
+
+
+# -- fault injection ----------------------------------------------------------
+
+#: engine/harness fault points. Each is fired exactly once per logical
+#: event by the owning layer:
+#:   arrow.read   - host-side Arrow -> engine table conversion (arrow_bridge)
+#:   device.put   - host -> device upload of a padded table (device.to_device)
+#:   jax.compile  - XLA trace/compile of a whole-plan program (CompiledQuery)
+#:   jax.execute  - execution of a device program (compiled run / eager record)
+#:   stream.spawn - throughput supervisor starting a stream attempt
+#:   query.run    - power runner starting a timed query (detail = query name)
+FAULT_POINTS = ("arrow.read", "device.put", "jax.compile", "jax.execute",
+                "stream.spawn", "query.run")
+
+#: default sleep for a ``hang`` spec with no explicit duration: long enough
+#: that only a deadline/supervisor kill ends the attempt.
+HANG_SECONDS = 3600.0
+
+
+@dataclass
+class FaultSpec:
+    """One armed fault. Spec-string grammar (property-file friendly):
+
+        point:action[:seconds][@probability][#times][/match]
+
+    e.g. ``jax.execute:hang:5#1`` (hang 5s, first firing only),
+    ``arrow.read:raise``, ``device.put:delay:0.2@0.5``,
+    ``query.run:raise/query1`` (only when the fired detail is query1).
+    """
+    point: str
+    action: str = "raise"           # raise | delay | hang
+    seconds: float = 0.0            # delay/hang duration (hang: 0 => HANG_SECONDS)
+    probability: float = 1.0
+    times: Optional[int] = None     # max firings; None = unlimited
+    match: Optional[str] = None     # exact match on the fire() detail
+    source: str = "manual"          # "config" specs replaced on reconfigure
+    fired: int = field(default=0, compare=False)
+
+    @classmethod
+    def parse(cls, text: str, source: str = "manual") -> "FaultSpec":
+        body, match = (text.split("/", 1) + [None])[:2] \
+            if "/" in text else (text, None)
+        body, times = body.split("#", 1) if "#" in body else (body, None)
+        body, prob = body.split("@", 1) if "@" in body else (body, None)
+        parts = body.split(":")
+        point = parts[0].strip()
+        if point not in FAULT_POINTS:
+            raise ValueError(f"unknown fault point {point!r} "
+                             f"(expected one of {FAULT_POINTS})")
+        action = parts[1].strip() if len(parts) > 1 else "raise"
+        if action not in ("raise", "delay", "hang"):
+            raise ValueError(f"unknown fault action {action!r} in {text!r} "
+                             "(expected raise, delay, or hang)")
+        seconds = float(parts[2]) if len(parts) > 2 else 0.0
+        return cls(point=point, action=action, seconds=seconds,
+                   probability=float(prob) if prob is not None else 1.0,
+                   times=int(times) if times is not None else None,
+                   match=match, source=source)
+
+    def applies(self, detail: str) -> bool:
+        if self.times is not None and self.fired >= self.times:
+            return False
+        return self.match is None or self.match == detail
+
+
+class FaultRegistry:
+    """Process-global registry of armed fault points.
+
+    Engine/harness code calls :meth:`fire` at each point; the fast path
+    (nothing armed) is one attribute read, so the hooks cost nothing in
+    production. Probability draws come from a seeded RNG in fire order, so
+    a run with probabilistic faults replays deterministically.
+    """
+
+    def __init__(self, seed: int = 0x5E51):
+        self._specs: list[FaultSpec] = []
+        self._lock = threading.Lock()
+        self._rng = random.Random(seed)
+        self._seed = seed
+
+    def arm(self, spec, **kwargs) -> FaultSpec:
+        """Arm a FaultSpec (or parse a spec string). Returns the armed spec
+        so callers can :meth:`disarm` it."""
+        if isinstance(spec, str):
+            spec = FaultSpec.parse(spec, **kwargs)
+        with self._lock:
+            self._specs.append(spec)
+        return spec
+
+    def disarm(self, spec: FaultSpec) -> None:
+        with self._lock:
+            if spec in self._specs:
+                self._specs.remove(spec)
+
+    def configure(self, texts: Iterable[str]) -> list[FaultSpec]:
+        """Install config-sourced specs, replacing any previous config batch
+        (manually armed specs are untouched). Called by Session.__init__
+        from ``EngineConfig.fault_points``."""
+        parsed = [FaultSpec.parse(t, source="config") for t in texts if t]
+        with self._lock:
+            self._specs = [s for s in self._specs if s.source != "config"]
+            self._specs.extend(parsed)
+        return parsed
+
+    def clear(self, point: Optional[str] = None) -> None:
+        with self._lock:
+            self._specs = [] if point is None else \
+                [s for s in self._specs if s.point != point]
+            self._rng = random.Random(self._seed)
+
+    def specs(self) -> list[FaultSpec]:
+        with self._lock:
+            return list(self._specs)
+
+    def would_raise(self, point: str, detail: str = "",
+                    aliases: tuple = ()) -> bool:
+        """Is a certain (p=1) raise-spec armed for this point/detail?
+        Lets the power runner skip warmup for queries whose timed run is
+        guaranteed to fail, without consuming the spec."""
+        with self._lock:
+            return any(s.point == point and s.action == "raise"
+                       and s.probability >= 1.0
+                       and any(s.applies(d) for d in (detail, *aliases))
+                       for s in self._specs)
+
+    def fire(self, point: str, detail: str = "", aliases: tuple = ()) -> None:
+        """Trigger any armed specs for ``point``. Raise-specs raise
+        FaultError; delay-specs sleep; hang-specs sleep (default
+        HANG_SECONDS) and then raise, so an abandoned deadline worker dies
+        cleanly when it wakes instead of touching shared state."""
+        if not self._specs:         # fast path: nothing armed
+            return
+        triggered: list[FaultSpec] = []
+        with self._lock:
+            for s in self._specs:
+                if s.point != point or \
+                        not any(s.applies(d) for d in (detail, *aliases)):
+                    continue
+                if s.probability < 1.0 and \
+                        self._rng.random() >= s.probability:
+                    continue
+                s.fired += 1
+                triggered.append(s)
+        for s in triggered:         # act outside the lock (sleeps)
+            where = f"{point} ({detail})" if detail else point
+            if s.action == "delay":
+                time.sleep(s.seconds)
+            elif s.action == "hang":
+                time.sleep(s.seconds if s.seconds > 0 else HANG_SECONDS)
+                raise FaultError(f"hung fault point woke at {where}")
+            else:
+                raise FaultError(f"injected fault at {where}")
+
+
+#: the process-global registry every engine/harness fault point fires into.
+FAULTS = FaultRegistry()

@@ -1,0 +1,503 @@
+"""Resilience layer tests: retry policies, deadlines, the fault-injection
+registry, supervised throughput (restart + kill + partial elapsed), power
+--resume, and the per-query deadline killing a hung device call.
+
+These are the ISSUE-1 acceptance demos: a stream configured to crash via
+the fault registry completes after a restart; an interrupted power run
+resumes without re-running completed queries; a hung ``jax.execute``
+fault point is killed by the per-query deadline and recorded as Failed.
+All fast and CPU-only (tiny hand-built parquet inputs, no datagen).
+"""
+import csv
+import glob
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from nds_tpu.config import EngineConfig
+from nds_tpu.power import _write_time_log, run_query_stream
+from nds_tpu.report import BenchReport
+from nds_tpu.resilience import (Deadline, DeadlineExceeded, FAULTS,
+                                FaultError, FaultSpec, RetryPolicy,
+                                TransientError, run_with_deadline)
+from nds_tpu.throughput import (IncompleteStreamLog, ThroughputError,
+                                run_throughput, scrape_log,
+                                status_csv_path, supervise_processes,
+                                throughput_elapsed)
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    FAULTS.clear()
+    yield
+    FAULTS.clear()
+
+
+# -- retry policy -------------------------------------------------------------
+
+def test_backoff_schedule_deterministic():
+    p = RetryPolicy(backoff_s=0.1, backoff_factor=2.0, max_backoff_s=0.35)
+    assert p.backoff(1) == pytest.approx(0.1)
+    assert p.backoff(2) == pytest.approx(0.2)
+    assert p.backoff(3) == pytest.approx(0.35)   # capped
+
+
+def test_retry_transient_then_succeeds():
+    calls, sleeps = [], []
+    p = RetryPolicy(max_attempts=3, backoff_s=0.1)
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise TransientError("hiccup")
+        return "ok"
+
+    assert p.call(flaky, sleep=sleeps.append) == "ok"
+    assert len(calls) == 3
+    assert sleeps == [pytest.approx(0.1), pytest.approx(0.2)]
+
+
+def test_retry_fatal_not_retried():
+    p = RetryPolicy(max_attempts=5, backoff_s=0.001)
+    calls = []
+
+    def doomed():
+        calls.append(1)
+        raise DeadlineExceeded("budget blown")
+
+    with pytest.raises(DeadlineExceeded):
+        p.call(doomed, sleep=lambda s: None)
+    assert len(calls) == 1
+    assert p.classify(DeadlineExceeded("x")) == "fatal"
+    assert p.classify(TransientError("x")) == "transient"
+    assert p.classify(FaultError("x")) == "transient"
+
+
+def test_retry_exhausts_attempts():
+    p = RetryPolicy(max_attempts=2, backoff_s=0.001)
+    calls = []
+
+    def always():
+        calls.append(1)
+        raise TransientError("nope")
+
+    with pytest.raises(TransientError):
+        p.call(always, sleep=lambda s: None)
+    assert len(calls) == 2
+
+
+# -- deadlines ----------------------------------------------------------------
+
+def test_deadline_expiry():
+    now = [0.0]
+    d = Deadline(1.0, clock=lambda: now[0])
+    assert not d.expired() and d.remaining() == pytest.approx(1.0)
+    now[0] = 1.5
+    assert d.expired()
+    with pytest.raises(DeadlineExceeded):
+        d.check("query42")
+    assert Deadline(None).remaining() is None
+    assert not Deadline(0).expired()     # 0 = unbounded
+
+
+def test_run_with_deadline_passthrough_and_timeout():
+    assert run_with_deadline(lambda x: x + 1, None, 41) == 42
+    assert run_with_deadline(lambda: "fast", 5.0) == "fast"
+    with pytest.raises(ValueError):      # worker errors re-raise in caller
+        run_with_deadline(lambda: (_ for _ in ()).throw(ValueError("x")), 5.0)
+    t0 = time.monotonic()
+    with pytest.raises(DeadlineExceeded):
+        run_with_deadline(time.sleep, 0.2, 5.0, label="hung query")
+    assert time.monotonic() - t0 < 3.0   # did not wait the full sleep
+
+
+# -- fault registry -----------------------------------------------------------
+
+def test_fault_spec_grammar():
+    s = FaultSpec.parse("jax.execute:hang:5#1")
+    assert (s.point, s.action, s.seconds, s.times) == \
+        ("jax.execute", "hang", 5.0, 1)
+    s = FaultSpec.parse("device.put:delay:0.2@0.5")
+    assert (s.action, s.seconds, s.probability) == ("delay", 0.2, 0.5)
+    s = FaultSpec.parse("query.run:raise/query1")
+    assert (s.action, s.match) == ("raise", "query1")
+    assert FaultSpec.parse("arrow.read").action == "raise"
+    with pytest.raises(ValueError):
+        FaultSpec.parse("warp.core:raise")
+    with pytest.raises(ValueError):
+        FaultSpec.parse("arrow.read:explode")
+
+
+def test_registry_fire_semantics():
+    FAULTS.arm("arrow.read:raise#1")
+    with pytest.raises(FaultError):
+        FAULTS.fire("arrow.read")
+    FAULTS.fire("arrow.read")                    # times=1: exhausted
+    FAULTS.fire("device.put")                    # other points unaffected
+
+    spec = FAULTS.arm("query.run:raise/query5")
+    FAULTS.fire("query.run", "query7")           # match gates on detail
+    with pytest.raises(FaultError):
+        FAULTS.fire("query.run", "query5_part2", aliases=("query5",))
+    assert FAULTS.would_raise("query.run", "query5")
+    assert not FAULTS.would_raise("query.run", "query7")
+    FAULTS.disarm(spec)
+    FAULTS.fire("query.run", "query5")           # disarmed
+
+    FAULTS.arm("stream.spawn:raise@0.0")         # p=0 never fires
+    FAULTS.fire("stream.spawn")
+
+    t0 = time.monotonic()
+    FAULTS.arm("jax.compile:delay:0.05")
+    FAULTS.fire("jax.compile")
+    assert time.monotonic() - t0 >= 0.05
+
+
+def test_registry_configure_replaces_config_batch():
+    manual = FAULTS.arm("arrow.read:raise")
+    FAULTS.configure(["device.put:raise"])
+    FAULTS.configure(["jax.execute:raise"])      # replaces the config batch
+    points = sorted(s.point for s in FAULTS.specs())
+    assert points == ["arrow.read", "jax.execute"]
+    FAULTS.disarm(manual)
+
+
+def test_config_fault_points_via_property_file(tmp_path):
+    prop = tmp_path / "engine.properties"
+    prop.write_text(
+        "nds.tpu.fault_points=arrow.read:raise#1\n"
+        "nds.tpu.query_timeout_s=1.5\n"
+        "nds.tpu.query_attempts=2\n"
+        "nds.tpu.stream_attempts=3\n"
+        "nds.tpu.use_jax=false\n")
+    cfg = EngineConfig.from_property_file(str(prop))
+    assert cfg.fault_points == ("arrow.read:raise#1",)
+    assert cfg.query_timeout_s == pytest.approx(1.5)
+    assert cfg.query_attempts == 2
+    assert cfg.stream_attempts == 3
+
+    from nds_tpu.engine import Session
+    session = Session(cfg)                       # arms the registry
+    session.register_arrow("t", pa.table({"a": [1, 2, 3]}))
+    with pytest.raises(FaultError, match="arrow.read"):
+        session.sql("SELECT COUNT(*) AS c FROM t")
+    out = session.sql("SELECT COUNT(*) AS c FROM t")   # spec exhausted
+    assert out.num_rows == 1
+
+
+# -- per-attempt report records ----------------------------------------------
+
+def test_report_records_attempts_and_retried_status():
+    r = BenchReport({}, app_name="t")
+    r.report_on(lambda: 42)
+    assert r.summary["attempts"] == [1]
+    assert r.summary["retriedStatus"] == [["Completed"]]
+
+    r2 = BenchReport({}, app_name="t")
+    state = {"n": 0}
+
+    def flaky():
+        state["n"] += 1
+        if state["n"] == 1:
+            raise TransientError("transient wobble")
+        return "ok"
+
+    out = r2.report_on(flaky, retry=RetryPolicy(max_attempts=3,
+                                                backoff_s=0.001))
+    assert out == "ok"
+    assert r2.summary["attempts"] == [2]
+    assert r2.summary["retriedStatus"] == [["Failed", "Completed"]]
+    # a retried success is not a clean Completed
+    assert r2.finalize_status() == "CompletedWithTaskFailures"
+    assert any("transient wobble" in e for e in r2.summary["exceptions"])
+
+    def always_fails():
+        raise TransientError("always")
+
+    r3 = BenchReport({}, app_name="t")
+    r3.report_on(always_fails, retry=RetryPolicy(max_attempts=2,
+                                                 backoff_s=0.001))
+    assert r3.summary["queryStatus"] == ["Failed"]
+    assert r3.summary["retriedStatus"] == [["Failed", "Failed"]]
+
+
+# -- tiny power/throughput environment ---------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_env(tmp_path_factory):
+    """A minimal power/throughput input: one parquet table and two stream
+    files of trivial queries — no datagen, sub-second streams."""
+    root = tmp_path_factory.mktemp("resilience")
+    ddim = root / "input" / "date_dim"
+    ddim.mkdir(parents=True)
+    pq.write_table(pa.table({
+        "d_date_sk": pa.array(range(40), type=pa.int64()),
+        "d_year": pa.array([1998 + i % 3 for i in range(40)],
+                           type=pa.int64()),
+    }), str(ddim / "part-0.parquet"))
+    streams = root / "streams"
+    streams.mkdir()
+    body = (
+        "-- start query 1 using template query1.tpl\n"
+        "SELECT COUNT(*) AS cnt FROM date_dim;\n"
+        "-- start query 2 using template query3.tpl\n"
+        "SELECT d_year, COUNT(*) AS c FROM date_dim "
+        "GROUP BY d_year ORDER BY d_year;\n")
+    for sid in (0, 1, 2):
+        (streams / f"query_{sid}.sql").write_text(body)
+    return str(root / "input"), str(streams), root
+
+
+def test_power_fault_inject_writes_failed_and_keeps_going(tiny_env, tmp_path):
+    """The registry-backed --fault_inject keeps the reference contract: the
+    injected query records Failed with the exception in its JSON summary
+    and the stream keeps going."""
+    inp, streams, _ = tiny_env
+    json_dir = str(tmp_path / "json")
+    rows = run_query_stream(inp, os.path.join(streams, "query_0.sql"),
+                            str(tmp_path / "t.csv"), backend="numpy",
+                            json_summary_folder=json_dir,
+                            fault_inject=["query1"])
+    assert [r[0] for r in rows] == ["query1", "query3"]
+    summaries = {}
+    for path in glob.glob(os.path.join(json_dir, "*.json")):
+        with open(path) as f:
+            summaries[os.path.basename(path).split("-")[1]] = json.load(f)
+    assert summaries["query1"]["queryStatus"] == ["Failed"]
+    assert any("injected fault" in e
+               for e in summaries["query1"]["exceptions"])
+    assert summaries["query3"]["queryStatus"] == ["Completed"]
+    # the sugar disarms its specs on the way out
+    assert not any(s.point == "query.run" for s in FAULTS.specs())
+
+
+def test_power_resume_skips_completed_queries(tiny_env, tmp_path):
+    """A power run interrupted mid-stream resumes from the flushed partial
+    log without re-running completed queries."""
+    inp, streams, _ = tiny_env
+    log = str(tmp_path / "time.csv")
+    # simulate an interrupted run: query1 recorded, no sentinel end rows
+    _write_time_log(log, 111, [("query1", 111, 222, 111)], None)
+    json_dir = str(tmp_path / "json")
+    rows = run_query_stream(inp, os.path.join(streams, "query_0.sql"),
+                            log, backend="numpy",
+                            json_summary_folder=json_dir, resume=True)
+    assert rows[0] == ("query1", 111, 222, 111)   # preserved, not re-run
+    assert [r[0] for r in rows] == ["query1", "query3"]
+    # only the remaining query produced a summary
+    ran = {os.path.basename(p).split("-")[1]
+           for p in glob.glob(os.path.join(json_dir, "*.json"))}
+    assert ran == {"query3"}
+    with open(log) as f:
+        rows_csv = list(csv.reader(f))
+    labels = [r[0] for r in rows_csv]
+    assert labels.count("query1") == 1
+    assert "Power End Time" in labels
+    start_row = rows_csv[labels.index("Power Start Time")]
+    assert start_row[1] == "111"                  # original start kept
+
+    # resuming a COMPLETE log is a no-op that preserves the sentinels
+    before = open(log).read()
+    rows2 = run_query_stream(inp, os.path.join(streams, "query_0.sql"),
+                             log, backend="numpy", resume=True)
+    assert [r[0] for r in rows2] == ["query1", "query3"]
+    assert open(log).read() == before
+
+
+def test_power_deadline_kills_hung_execute(tiny_env, tmp_path):
+    """A hung jax.execute fault point is killed by the per-query deadline
+    and recorded as Failed; the stream keeps going."""
+    inp, streams, _ = tiny_env
+    FAULTS.arm("jax.execute:hang:3#1")
+    json_dir = str(tmp_path / "json")
+    t0 = time.monotonic()
+    rows = run_query_stream(inp, os.path.join(streams, "query_0.sql"),
+                            str(tmp_path / "t.csv"), backend="jax",
+                            json_summary_folder=json_dir, query_timeout=0.5)
+    assert [r[0] for r in rows] == ["query1", "query3"]
+    assert time.monotonic() - t0 < 60
+    summaries = {}
+    for path in glob.glob(os.path.join(json_dir, "*.json")):
+        with open(path) as f:
+            summaries[os.path.basename(path).split("-")[1]] = json.load(f)
+    assert summaries["query1"]["queryStatus"] == ["Failed"]
+    assert any("exceeded" in e and "budget" in e
+               for e in summaries["query1"]["exceptions"])
+    assert summaries["query3"]["queryStatus"][0] in (
+        "Completed", "CompletedWithTaskFailures")
+
+
+def test_power_query_retry_records_attempts(tiny_env, tmp_path):
+    """A transiently failing query retries and completes; the summary
+    carries the per-attempt trail."""
+    inp, streams, _ = tiny_env
+    FAULTS.arm("query.run:raise#1/query1")
+    json_dir = str(tmp_path / "json")
+    rows = run_query_stream(inp, os.path.join(streams, "query_0.sql"),
+                            str(tmp_path / "t.csv"), backend="numpy",
+                            json_summary_folder=json_dir, query_attempts=2)
+    assert [r[0] for r in rows] == ["query1", "query3"]
+    for path in glob.glob(os.path.join(json_dir, "*.json")):
+        with open(path) as f:
+            d = json.load(f)
+        if os.path.basename(path).split("-")[1] == "query1":
+            assert d["attempts"] == [2]
+            assert d["retriedStatus"] == [["Failed", "Completed"]]
+            assert d["queryStatus"] == ["CompletedWithTaskFailures"]
+
+
+# -- supervised throughput ----------------------------------------------------
+
+def test_throughput_stream_crash_restarts_and_completes(tiny_env, tmp_path):
+    """A stream configured to crash via the fault registry completes after
+    a restart; per-stream status lands in the CSV and elapsed is real."""
+    inp, streams, _ = tiny_env
+    log_dir = str(tmp_path / "logs")
+    FAULTS.arm("stream.spawn:raise#1")
+    elapsed = run_throughput(inp, streams, [1, 2], log_dir,
+                             backend="numpy", mode="thread",
+                             max_attempts=2, retry_backoff_s=0.01)
+    assert elapsed > 0
+    with open(status_csv_path(log_dir)) as f:
+        status = {int(r["stream"]): r for r in csv.DictReader(f)}
+    assert {s["status"] for s in status.values()} == {"Completed"}
+    # exactly one stream burned the injected crash and restarted
+    assert sorted(int(s["attempts"]) for s in status.values()) == [1, 2]
+
+
+def test_throughput_permanent_failure_reports_partial_elapsed(tiny_env,
+                                                              tmp_path):
+    inp, streams, _ = tiny_env
+    log_dir = str(tmp_path / "logs")
+    with pytest.raises(ThroughputError) as ei:
+        # stream 7 has no stream file: every attempt fails
+        run_throughput(inp, streams, [1, 7], log_dir, backend="numpy",
+                       mode="thread", max_attempts=2, retry_backoff_s=0.01)
+    err = ei.value
+    assert err.failed == [7]
+    assert err.partial_elapsed is not None and err.partial_elapsed > 0
+    assert "partial elapsed" in str(err)
+    with open(status_csv_path(log_dir)) as f:
+        status = {int(r["stream"]): r for r in csv.DictReader(f)}
+    assert status[1]["status"] == "Completed"
+    assert status[7]["status"] == "Failed"
+    assert int(status[7]["attempts"]) == 2
+
+
+def test_supervise_processes_retry_and_timeout(tmp_path):
+    """Process-mode supervision: a crashing child restarts with backoff and
+    completes; a hung child is killed at its budget and marked TimedOut."""
+    marker = str(tmp_path / "marker")
+    crash_once = [sys.executable, "-c",
+                  "import os, sys\n"
+                  f"p = {marker!r}\n"
+                  "if not os.path.exists(p):\n"
+                  "    open(p, 'w').close(); sys.exit(3)\n"]
+    hang = [sys.executable, "-c", "import time; time.sleep(30)"]
+    t0 = time.monotonic()
+    statuses = {s.stream: s for s in supervise_processes(
+        [(1, crash_once), (2, hang)], max_attempts=2, stream_timeout=1.0,
+        backoff_s=0.01, poll_s=0.02)}
+    assert statuses[1].status == "Completed" and statuses[1].attempts == 2
+    assert statuses[2].status == "TimedOut"
+    assert "budget" in statuses[2].error
+    assert time.monotonic() - t0 < 20      # both hangs killed, not waited
+
+
+def test_supervise_processes_kills_children_on_abandon(tmp_path):
+    """An abandoned round (interrupt mid-supervision) never leaks sibling
+    processes."""
+    procs = []
+
+    def spawn(cmd):
+        p = subprocess.Popen(cmd)
+        procs.append(p)
+        return p
+
+    calls = [0]
+
+    def clock():
+        calls[0] += 1
+        if calls[0] > 8:
+            raise KeyboardInterrupt
+        return time.monotonic()
+
+    hang = [sys.executable, "-c", "import time; time.sleep(30)"]
+    with pytest.raises(KeyboardInterrupt):
+        # stream_timeout keeps the supervisor consulting the clock each
+        # poll round so the simulated interrupt lands mid-supervision
+        supervise_processes([(1, hang), (2, hang)], max_attempts=1,
+                            stream_timeout=50.0, poll_s=0.02,
+                            spawn=spawn, clock=clock)
+    assert procs, "supervisor never spawned"
+    for p in procs:
+        assert p.poll() is not None        # killed, not leaked
+
+
+def test_throughput_process_mode_tiny(tiny_env, tmp_path):
+    """One real process-mode round over the tiny input: both streams
+    complete supervised, the status CSV and elapsed are written."""
+    inp, streams, _ = tiny_env
+    log_dir = str(tmp_path / "logs")
+    elapsed = run_throughput(inp, streams, [1, 2], log_dir,
+                             backend="numpy", mode="process")
+    assert elapsed > 0
+    with open(status_csv_path(log_dir)) as f:
+        status = {int(r["stream"]): r for r in csv.DictReader(f)}
+    assert {s["status"] for s in status.values()} == {"Completed"}
+
+
+# -- degraded scraping / bench satellites ------------------------------------
+
+def test_scrape_log_names_incomplete_streams(tmp_path):
+    good = str(tmp_path / "throughput_1.csv")
+    _write_time_log(good, 1000, [("query1", 1000, 1500, 500)], 2000)
+    interrupted = str(tmp_path / "throughput_2.csv")
+    _write_time_log(interrupted, 1000, [("query1", 1000, 1500, 500)], None)
+
+    assert scrape_log(good) == (1000, 2000)
+    with pytest.raises(IncompleteStreamLog, match="throughput_2"):
+        scrape_log(interrupted)
+    assert scrape_log(interrupted, strict=False) is None
+
+    missing = str(tmp_path / "throughput_3.csv")
+    with pytest.raises(IncompleteStreamLog) as ei:
+        throughput_elapsed([good, interrupted, missing])
+    msg = str(ei.value)
+    assert "throughput_2" in msg and "throughput_3" in msg
+    assert "throughput_1" not in msg
+    # partial elapsed over the complete logs only
+    assert throughput_elapsed([good, interrupted, missing],
+                              allow_partial=True) == pytest.approx(1.0)
+    with pytest.raises(IncompleteStreamLog):
+        throughput_elapsed([interrupted], allow_partial=True)
+
+
+def test_get_load_end_timestamp_missing_report_explains(tmp_path):
+    from nds_tpu import bench
+    missing = str(tmp_path / "load_report.txt")
+    with pytest.raises(FileNotFoundError, match="skipped but"):
+        bench.get_load_end_timestamp(missing)
+
+
+def test_bench_phase_retry_config():
+    """Phase-level retry wiring: the policy built from the YAML resilience
+    section retries a transiently failing phase."""
+    calls = []
+
+    def phase():
+        calls.append(1)
+        if len(calls) == 1:
+            raise ThroughputError("streams failed", partial_elapsed=1.0,
+                                  failed=[3])
+        return 7.5
+
+    policy = RetryPolicy(max_attempts=2, backoff_s=0.001)
+    assert policy.call(phase) == 7.5
+    assert len(calls) == 2

@@ -5,6 +5,10 @@
 # Stages:
 #   native     - build the C++ data generator and self-check one tiny table
 #   resilience - fast smoke of the fault-injection/retry/deadline layer
+#   planner    - late-materialization legality/differential + capacity-ladder
+#                tests (fast, CPU backend): the rewrite changes plans for
+#                every dimension-grouped aggregate, so its SQLite-oracle
+#                exactness gate runs early and cheaply
 #   test       - full pytest suite on an 8-virtual-device CPU mesh
 #   bench      - quick bench slice (SF 0.01) to catch perf regressions early
 #   all        - every stage in order
@@ -37,6 +41,11 @@ stage_resilience() {
     (cd "$REPO" && python -m pytest tests/test_resilience.py -q)
 }
 
+stage_planner() {
+    (cd "$REPO" && python -m pytest tests/test_late_materialization.py \
+        tests/test_capacity_ladder.py -q)
+}
+
 stage_test() {
     (cd "$REPO" && python -m pytest tests/ -q --durations=15)
 }
@@ -52,10 +61,12 @@ stage_bench() {
 case "${1:-all}" in
     native)     stage_native ;;
     resilience) stage_resilience ;;
+    planner)    stage_planner ;;
     test)       stage_test ;;
     bench)      stage_bench ;;
-    all)        stage_native; stage_resilience; stage_test; stage_bench ;;
-    --list)     echo "native resilience test bench all" ;;
-    *) echo "usage: run_ci.sh [native|resilience|test|bench|all|--list]" >&2
+    all)        stage_native; stage_resilience; stage_planner; stage_test
+                stage_bench ;;
+    --list)     echo "native resilience planner test bench all" ;;
+    *) echo "usage: run_ci.sh [native|resilience|planner|test|bench|all|--list]" >&2
        exit 2 ;;
 esac

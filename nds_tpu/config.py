@@ -47,6 +47,16 @@ class EngineConfig:
     # (partial-schema-preserving re-aggregation): bounds host memory when
     # group cardinality is large (customer-grained q4-class aggregates)
     stream_compact_rows: int = 8_000_000
+    # late materialization for join-heavy aggregates (planner.
+    # _late_materialization): group by the dimension's surrogate join key and
+    # gather dimension attributes AFTER aggregation instead of materializing
+    # them at fact scale (q72-class 16M-row gathers). Property:
+    # nds.tpu.late_materialization; runners expose --no_late_mat for A/B.
+    late_materialization: bool = True
+    # the rewrite only fires when some scan under the aggregate is at least
+    # this big (small plans gain nothing and pay an extra small join + merge
+    # aggregate). 0 fires unconditionally.
+    late_mat_min_rows: int = 1 << 20
     # run jitted per-op kernels (True) or pure-numpy fallback (False, debug only)
     use_jax: bool = True
     # compile whole plans to one XLA program on re-execution (record/replay);

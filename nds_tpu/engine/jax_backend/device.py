@@ -24,10 +24,26 @@ from ..column import Column, Table, is_dec, phys_np
 _NULL_CODE = -1
 
 
+# Below this row count, capacities are powers of two (few program shapes,
+# compile-cache friendly). Above it, gather/sort cost scales with CAP and a
+# 2x step overshoots the actual row count by 1.5x on average (PERF.md r5
+# headroom #2), so the ladder gains 3*2^(k-1) midpoints — 4M, 6M, 8M, 12M,
+# 16M, 24M... — bounding overshoot at 1.5x for a bounded set of extra
+# program shapes. Midpoints keep every power-of-two divisor up to 2^(k-1),
+# so mesh sharding (capacity % mesh.size == 0) is unaffected.
+CAP_LADDER_MIN = 4 << 20
+
+
 def bucket(n: int, minimum: int = 8) -> int:
-    """Round a row count up to the next power of two (compile-cache friendly)."""
+    """Round a row count up to the capacity ladder: powers of two, plus
+    3*2^(k-1) midpoints above CAP_LADDER_MIN rows."""
     c = max(int(n), minimum)
-    return 1 << (c - 1).bit_length()
+    p = 1 << (c - 1).bit_length()
+    if p > CAP_LADDER_MIN:
+        mid = 3 * (p >> 2)          # 0.75 * p, the step between p/2 and p
+        if c <= mid:
+            return mid
+    return p
 
 
 def phys_dtype(logical: str):

@@ -122,6 +122,11 @@ class CompiledQuery:
         self.shard_min_rows = shard_min_rows
         self._fn = None
         self._aot = None     # AOT executable from precompile()
+        self._aot_specs = None  # flat (shape, dtype) list the AOT was lowered for
+        # _SHARED_PROGRAMS hands one CompiledQuery to every stream of a
+        # template: concurrent multi-stream runs must not race the lazy
+        # _fn/_aot initialization (ADVICE r5)
+        self._lock = threading.Lock()
 
     def _trace(self, scan_tuple: tuple, params: tuple):
         scans = dict(zip(self.scan_keys, scan_tuple))
@@ -158,14 +163,42 @@ class CompiledQuery:
 
         from ...resilience import FAULTS
         FAULTS.fire("jax.compile")
-        if self._fn is None:
-            self._fn = jax.jit(self._trace)
+        with self._lock:
+            if self._fn is None:
+                self._fn = jax.jit(self._trace)
+            fn = self._fn
         params = tuple(jax.ShapeDtypeStruct((), phys_dtype(d))
                        for d in self.param_dtypes)
         t0 = _time.perf_counter()
-        self._aot = self._fn.lower(scan_specs, params).compile()
+        aot = fn.lower(scan_specs, params).compile()
+        with self._lock:
+            self._aot = aot
+            self._aot_specs = self._flat_specs((scan_specs, params))
         if stats is not None:
             stats["precompile_s"] = round(_time.perf_counter() - t0, 3)
+
+    @staticmethod
+    def _flat_specs(tree) -> Optional[list]:
+        """Flat (shape, dtype) list of a pytree of arrays/specs; None when a
+        leaf carries neither (spec checking is then unavailable)."""
+        leaves = jax.tree_util.tree_leaves(tree)
+        out = []
+        for leaf in leaves:
+            shape = getattr(leaf, "shape", None)
+            dtype = getattr(leaf, "dtype", None)
+            if shape is None or dtype is None:
+                return None
+            out.append((tuple(shape), np.dtype(dtype)))
+        return out
+
+    def _specs_match(self, args) -> bool:
+        """Do concrete args structurally fit the AOT executable's input
+        specs? Shape/dtype only — shardings/placement are re-checked by the
+        runtime itself (the narrow except in run())."""
+        if self._aot_specs is None:
+            return False
+        got = self._flat_specs(args)
+        return got is not None and got == self._aot_specs
 
     def run(self, scans: dict, values: tuple = (),
             stats: Optional[dict] = None,
@@ -173,25 +206,42 @@ class CompiledQuery:
         import time as _time
 
         from ...resilience import FAULTS
-        first = self._fn is None
-        if first:
-            FAULTS.fire("jax.compile")
-            self._fn = jax.jit(self._trace)
+        with self._lock:
+            first = self._fn is None
+            if first:
+                FAULTS.fire("jax.compile")
+                self._fn = jax.jit(self._trace)
+            fn, aot = self._fn, self._aot
         FAULTS.fire("jax.execute")
         t1 = _time.perf_counter()
-        if self._aot is not None:
+        args = self._args(scans, values)
+        if aot is not None and not self._specs_match(args):
+            # shape/dtype drift against the precompiled specs: take the jit
+            # path explicitly (the persistent compile cache still serves the
+            # binary when the lowering matches) instead of letting the AOT
+            # call fail and masking the error class
+            with self._lock:
+                if self._aot is aot:
+                    self._aot = None
+            aot = None
+        if aot is not None:
             try:
-                out, checks = self._aot(*self._args(scans, values))
-            except (TypeError, ValueError):
-                # spec/arg mismatch (shape or placement drift): fall back to
-                # the jit path once — the persistent compile cache still
-                # serves the binary if the lowering matches. Runtime errors
-                # (JaxRuntimeError: OOM, tunnel drops) propagate to the
-                # caller's retry/rt_failures machinery instead.
-                self._aot = None
-                out, checks = self._fn(*self._args(scans, values))
+                out, checks = aot(*args)
+            except (TypeError, ValueError) as aot_err:
+                # drift the shape check cannot see (committed-device /
+                # sharding mismatch). Retry via jit once; a jit failure of
+                # the SAME class is a genuine runtime error — re-raise it
+                # with the AOT error as explicit context instead of
+                # swallowing the original.
+                with self._lock:
+                    if self._aot is aot:
+                        self._aot = None
+                try:
+                    out, checks = fn(*args)
+                except type(aot_err):
+                    raise aot_err
         else:
-            out, checks = self._fn(*self._args(scans, values))
+            out, checks = fn(*args)
         # ONE device_get for result + checks: tunneled platforms charge a
         # fixed RTT per transfer, so piecemeal np.asarray would dominate.
         # keep_device (segment outputs feeding downstream programs): only

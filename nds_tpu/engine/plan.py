@@ -133,6 +133,11 @@ class JoinNode(PlanNode):
     right_keys: list[BExpr] = field(default_factory=list)
     residual: Optional[BExpr] = None  # extra non-equi condition, over combined schema
     null_aware: bool = False  # NOT IN semantics for anti joins
+    # late materialization (planner._late_materialization): this join gathers
+    # dimension attributes AFTER aggregation against a unique-key build side.
+    # The flag is an annotation (execution is a plain inner join); it blocks
+    # re-application of the rewrite and makes rewritten plans inspectable.
+    late_mat: bool = False
 
 
 @dataclass

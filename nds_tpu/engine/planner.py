@@ -77,6 +77,15 @@ class Catalog:
     # decimal_physical="i64": CAST(x AS DECIMAL(p,s)) binds to "dec{s}"
     # instead of float (exact scaled-int64 decimals)
     dec_enabled: bool = False
+    # table -> columns declared single-column unique (dimension surrogate
+    # keys; schema.UNIQUE_KEYS or an explicit register_* declaration). The
+    # late-materialization legality analysis requires the deferred join key
+    # to be provably unique — a non-unique build side would double-count
+    # through the post-aggregation attribute join.
+    unique_cols: dict = field(default_factory=dict)
+    # late-materialization rewrite toggle + size gate (EngineConfig mirrors)
+    late_mat: bool = True
+    late_mat_min_rows: int = 1 << 20
 
     def schema(self, name: str) -> tuple[list[str], list[str]]:
         if name not in self.tables:
@@ -86,6 +95,9 @@ class Catalog:
 
     def est_rows(self, name: str) -> int:
         return self.tables[name][2] if name in self.tables else 1000
+
+    def is_unique(self, table: str, column: str) -> bool:
+        return column in self.unique_cols.get(table, ())
 
 
 # ---------------------------------------------------------------------------
@@ -121,6 +133,19 @@ class Planner:
         node = self._plan_body(q.body, outer, ctes, q.order_by, q.limit)
         if top:
             node.cte_segments = list(self.cte_segments)
+            if self.catalog.late_mat and \
+                    not os.environ.get("NDS_TPU_NO_LATE_MAT"):
+                # BEFORE pruning: the declaration-order permutation projects
+                # are still full-width bijections, so the surrogate join key
+                # is expressible in the aggregate's input space (pruning
+                # would have dropped it — nothing above the join consumes it)
+                node2 = _late_materialization(node, self.catalog)
+                if node2 is not node:
+                    segs = getattr(node, "cte_segments", [])
+                    live = {id(n) for n in P.iter_plan_nodes(node2)}
+                    node2.cte_segments = [(fp, n) for fp, n in segs
+                                          if id(n) in live]
+                    node = node2
             if not os.environ.get("NDS_TPU_NO_COLPRUNE"):
                 from .colprune import prune_plan
                 node = prune_plan(node)
@@ -999,7 +1024,8 @@ class Planner:
         return node, new_scope, rewrites
 
     # -- windows -------------------------------------------------------------
-    def _exact_rational_keys(self, rel, key: "P.SortKey") -> list:
+    def _exact_rational_keys(self, rel, key: "P.SortKey"
+                             ) -> tuple["P.PlanNode", list]:
         """Rank order keys that are float divisions of integer-typed values
         (ints or scaled-int decimals) are replaced by TWO exact integer
         keys — floor(p/q) and 56 binary fraction digits — so rank ties are
@@ -1010,7 +1036,10 @@ class Planner:
         reference validator carves out per-query for floats,
         nds/nds_validate.py:231-244; exact keys remove the need for any
         q49 carve-out here). The operands are hoisted through the
-        intervening ProjectNode chain as hidden columns."""
+        intervening ProjectNode chain as hidden columns; the chain rebuilds
+        COPY-ON-WRITE (returning the possibly-new rel) — chain nodes can be
+        shared CTE plan objects, and widening them in place would shift
+        positional bindings for every other consumer (ADVICE r5)."""
         chain: list[P.ProjectNode] = []
         e, node = key.expr, rel
         while isinstance(e, P.BCol) and isinstance(node, P.ProjectNode):
@@ -1018,7 +1047,7 @@ class Planner:
             e = node.exprs[e.index]
             node = node.child
         if not (isinstance(e, P.BCall) and e.op == "div"):
-            return [key]
+            return rel, [key]
 
         def strip_cast(x):
             while isinstance(x, P.BCall) and x.op == "cast" \
@@ -1028,31 +1057,50 @@ class Planner:
 
         num, den = strip_cast(e.args[0]), strip_cast(e.args[1])
         if num is None or den is None:
-            return [key]
+            return rel, [key]
 
-        def append_col(proj: P.ProjectNode, expr, name: str) -> int:
+        appends: list[list] = [[] for _ in chain]  # per chain node
+
+        def append_col(ci: int, expr, name: str) -> int:
+            proj = chain[ci]
             for i, ex in enumerate(proj.exprs):
                 if repr(ex) == repr(expr):
                     return i
-            proj.exprs.append(expr)
-            proj.out_names.append(name)
-            proj.out_dtypes.append(expr.dtype)
-            return len(proj.exprs) - 1
+            for k, (ex, _nm) in enumerate(appends[ci]):
+                if repr(ex) == repr(expr):
+                    return len(proj.exprs) + k
+            appends[ci].append((expr, name))
+            return len(proj.exprs) + len(appends[ci]) - 1
 
         cols = []
         for opnd, tag in ((num, "num"), (den, "den")):
             if not chain:
                 cols.append(opnd)   # already in rel's scope
                 continue
-            idx = append_col(chain[-1], opnd, f"__rat_{tag}")
-            for proj in reversed(chain[:-1]):
-                idx = append_col(proj, P.BCol(opnd.dtype, idx,
-                                              f"__rat_{tag}"),
+            idx = append_col(len(chain) - 1, opnd, f"__rat_{tag}")
+            for ci in range(len(chain) - 2, -1, -1):
+                idx = append_col(ci, P.BCol(opnd.dtype, idx, f"__rat_{tag}"),
                                  f"__rat_{tag}")
             cols.append(P.BCol(opnd.dtype, idx, f"__rat_{tag}"))
-        return [P.SortKey(P.BCall("int", op, list(cols)),
-                          key.asc, key.nulls_first)
-                for op in ("ratdiv_hi", "ratdiv_lo")]
+        if chain:
+            rebuilt: Optional[P.PlanNode] = None
+            for ci in range(len(chain) - 1, -1, -1):
+                proj = chain[ci]
+                child = rebuilt if rebuilt is not None else proj.child
+                if appends[ci] or child is not proj.child:
+                    rebuilt = replace(
+                        proj, child=child,
+                        exprs=list(proj.exprs) + [ex for ex, _ in appends[ci]],
+                        out_names=list(proj.out_names) +
+                                  [nm for _, nm in appends[ci]],
+                        out_dtypes=list(proj.out_dtypes) +
+                                   [ex.dtype for ex, _ in appends[ci]])
+                else:
+                    rebuilt = proj
+            rel = rebuilt
+        return rel, [P.SortKey(P.BCall("int", op, list(cols)),
+                               key.asc, key.nulls_first)
+                     for op in ("ratdiv_hi", "ratdiv_lo")]
 
     def _plan_windows(self, rel, scope, win_calls, binder, ctes, outer):
         uniq: list[A.FuncCall] = []
@@ -1074,8 +1122,11 @@ class Planner:
                                       name=_display_name(fc)))
         for f in funcs:
             if f.func in ("rank", "dense_rank") and f.order_by:
-                f.order_by = [k2 for k in f.order_by
-                              for k2 in self._exact_rational_keys(rel, k)]
+                new_keys = []
+                for k in f.order_by:
+                    rel, ks = self._exact_rational_keys(rel, k)
+                    new_keys.extend(ks)
+                f.order_by = new_keys
         out_names = list(rel.out_names) + [f.name for f in funcs]
         out_dtypes = list(rel.out_dtypes) + [f.dtype for f in funcs]
         node = P.WindowNode(rel, funcs, out_names=out_names,
@@ -1098,6 +1149,331 @@ class Planner:
 # ---------------------------------------------------------------------------
 # binder: AST expression -> bound expression
 # ---------------------------------------------------------------------------
+
+# ---------------------------------------------------------------------------
+# late materialization (q72-class): group by surrogate keys, gather dimension
+# attributes after aggregation
+# ---------------------------------------------------------------------------
+
+def _lm_compose(chain: list, depth: int, idx: int) -> int:
+    """Map a column index through the pure-BCol project chain below `depth`
+    (later chain entries are deeper), landing in join-tree output space."""
+    for p in chain[depth:]:
+        idx = p.exprs[idx].index
+    return idx
+
+
+def _lm_refs(expr, chain: list, depth: int) -> set[int]:
+    """Join-space column indices referenced by an expression bound at chain
+    depth `depth`. Embedded subquery plans are closed (decorrelated) and
+    reference their own spaces — ignored."""
+    from .colprune import _expr_refs
+    refs: set[int] = set()
+    _expr_refs(expr, refs, [])
+    return {_lm_compose(chain, depth, r) for r in refs}
+
+
+def _lm_shared_nodes(plan: P.PlanNode) -> set[int]:
+    """Node ids with more than one plan-DAG parent, plus every node of a
+    registered CTE segment subtree: the attribute-join side must be cloned,
+    and cloning shared work (or a segment-cache slot) would silently
+    duplicate it."""
+    from .streaming import _expr_subplans
+    counts: dict[int, int] = {}
+    for nd in P.iter_plan_nodes(plan):
+        for f in ("child", "left", "right"):
+            sub = getattr(nd, f, None)
+            if isinstance(sub, P.PlanNode):
+                counts[id(sub)] = counts.get(id(sub), 0) + 1
+        for sp in _expr_subplans(nd):
+            counts[id(sp)] = counts.get(id(sp), 0) + 1
+    out = {i for i, c in counts.items() if c > 1}
+    for _fp, seg in getattr(plan, "cte_segments", None) or []:
+        out.update(id(x) for x in P.iter_plan_nodes(seg))
+    return out
+
+
+def _lm_clonable(node: P.PlanNode, shared: set[int]) -> bool:
+    """A dimension subtree we may duplicate for the post-agg gather: scans,
+    filters, and projects only; no shared nodes; no embedded subquery plans
+    (cloning would fork their execution)."""
+    from .streaming import _expr_subplans
+    for x in P.iter_plan_nodes(node):
+        if not isinstance(x, (P.ScanNode, P.FilterNode, P.ProjectNode)):
+            return False
+        if id(x) in shared or _expr_subplans(x):
+            return False
+    return True
+
+
+def _lm_clone(node: P.PlanNode) -> P.PlanNode:
+    """Fresh node objects for a Scan/Filter/Project subtree (expressions are
+    shared — they are treated immutably everywhere). Distinct identity keeps
+    colprune's needed-set union from widening the pre-agg build side with the
+    post-agg attribute columns."""
+    if isinstance(node, P.ScanNode):
+        return replace(node, columns=list(node.columns),
+                       out_names=list(node.out_names),
+                       out_dtypes=list(node.out_dtypes))
+    return replace(node, child=_lm_clone(node.child),
+                   out_names=list(node.out_names),
+                   out_dtypes=list(node.out_dtypes))
+
+
+def _lm_key_scan(node: P.PlanNode, idx: int):
+    """Trace output column `idx` of a dim subtree down to its source scan
+    column; (table, column) or None when the path is not a pure passthrough."""
+    while True:
+        if isinstance(node, P.ProjectNode):
+            e = node.exprs[idx]
+            if not isinstance(e, P.BCol):
+                return None
+            idx = e.index
+            node = node.child
+        elif isinstance(node, P.FilterNode):
+            node = node.child
+        elif isinstance(node, P.ScanNode):
+            return node.table, node.columns[idx]
+        else:
+            return None
+
+
+def _try_late_mat(agg: P.AggregateNode, catalog: "Catalog",
+                  shared: set[int]) -> Optional[P.PlanNode]:
+    """Rewrite one aggregate-over-join to late-materialized form, or None.
+
+    Legality: each deferred dimension joins inner on a single catalog-unique
+    key with no residual, and its columns are consumed ONLY as plain-column
+    group keys (pre-agg filters, aggregate arguments, other joins' keys, and
+    computed group expressions keep a dimension pinned). Exactness: grouping
+    by the surrogate key is finer than grouping by its attributes (the key
+    functionally determines them through a unique-key join), so a merge
+    aggregate over the original group values — the streaming partial/final
+    decomposition — restores the exact result, including avg (sum+count) and
+    all-NULL sums."""
+    from .streaming import _decompose, _final_builder, _mergeable
+
+    if agg.rollup or agg.rollup_levels is not None or not agg.group_exprs:
+        return None
+    if not _mergeable(agg):
+        return None
+
+    # descend pure-BCol projects and filters to the join tree
+    chain: list[P.ProjectNode] = []
+    filters: list[tuple] = []
+    node = agg.child
+    while True:
+        if isinstance(node, P.ProjectNode) and \
+                all(isinstance(e, P.BCol) for e in node.exprs):
+            chain.append(node)
+            node = node.child
+        elif isinstance(node, P.FilterNode):
+            filters.append((node.predicate, len(chain)))
+            node = node.child
+        else:
+            break
+    if not isinstance(node, P.JoinNode):
+        return None
+
+    # size gate: the fact-scale gathers are the win; tiny plans only pay the
+    # extra join + merge aggregate
+    if catalog.late_mat_min_rows > 0:
+        big = max((catalog.est_rows(s.table)
+                   for s in P.iter_plan_nodes(agg.child)
+                   if isinstance(s, P.ScanNode)), default=0)
+        if big < catalog.late_mat_min_rows:
+            return None
+
+    # flatten the left spine; every spine join's output keeps its left side
+    # as a positional prefix, so right-side spans are valid in the top space
+    cands: list[dict] = []
+    consumed: set[int] = set()
+    cur = node
+    while isinstance(cur, (P.JoinNode, P.FilterNode)):
+        if isinstance(cur, P.FilterNode):
+            filters.append((cur.predicate, len(chain)))
+            cur = cur.child
+            continue
+        for k in cur.left_keys:
+            consumed |= _lm_refs(k, chain, len(chain))
+        if cur.residual is not None:
+            consumed |= _lm_refs(cur.residual, chain, len(chain))
+        if cur.kind in ("full", "right"):
+            # null-extended left rows below would carry NULL surrogate keys
+            # the post-agg inner join could not reproduce: stop here
+            break
+        if cur.kind == "inner" and not cur.late_mat \
+                and cur.residual is None \
+                and len(cur.left_keys) == 1 and len(cur.right_keys) == 1 \
+                and isinstance(cur.right_keys[0], P.BCol):
+            cands.append({"join": cur, "off": len(cur.left.out_names),
+                          "w": len(cur.right.out_names),
+                          "kidx": cur.right_keys[0].index})
+        cur = cur.left
+    if not cands:
+        return None
+
+    for pred, depth in filters:
+        consumed |= _lm_refs(pred, chain, depth)
+    for s in agg.aggs:
+        if s.arg is not None:
+            consumed |= _lm_refs(s.arg, chain, 0)
+
+    def find_cand(gcol: int) -> Optional[int]:
+        for ci, c in enumerate(cands):
+            if c["off"] <= gcol < c["off"] + c["w"]:
+                return ci
+        return None
+
+    # classify group exprs: a plain dim-column BCol may defer; anything else
+    # consumes its columns pre-agg
+    gclass: list = []
+    for g in agg.group_exprs:
+        ci = None
+        if isinstance(g, P.BCol):
+            gcol = _lm_compose(chain, 0, g.index)
+            ci = find_cand(gcol)
+        if ci is None:
+            consumed |= _lm_refs(g, chain, 0)
+            gclass.append(None)
+        else:
+            gclass.append((ci, gcol))
+
+    elig: dict[int, dict] = {}
+    for ci, c in enumerate(cands):
+        span = set(range(c["off"], c["off"] + c["w"]))
+        if consumed & span:
+            continue
+        keyg = c["off"] + c["kidx"]
+        if not any(cl is not None and cl[0] == ci and cl[1] != keyg
+                   for cl in gclass):
+            continue            # no deferred attribute: nothing to gain
+        if not _lm_clonable(c["join"].right, shared):
+            continue
+        traced = _lm_key_scan(c["join"].right, c["kidx"])
+        if traced is None or not catalog.is_unique(*traced):
+            continue
+        elig[ci] = c
+
+    # the surrogate key must be expressible in the aggregate's input space
+    # (pre-prune permutation projects are full-width, so it normally is);
+    # prefer the fact-side key column — the gathered dim key then dies in
+    # the compiled program's DCE
+    inv: dict[int, int] = {}
+    for t in range(len(agg.child.out_names)):
+        inv.setdefault(_lm_compose(chain, 0, t), t)
+    for ci in list(elig):
+        c = elig[ci]
+        lk = c["join"].left_keys[0]
+        src = inv.get(lk.index) if isinstance(lk, P.BCol) else None
+        if src is None:
+            src = inv.get(c["off"] + c["kidx"])
+        if src is None:
+            del elig[ci]
+        else:
+            c["key_top"] = src
+    if not elig:
+        return None
+
+    # assemble: partial agg by surrogate keys -> attribute joins against
+    # cloned dims -> projection into the partial schema -> merge aggregate
+    n = len(agg.group_exprs)
+    partial_specs, recipes, p_names, p_dtypes = _decompose(agg)
+    pkeys: list[P.BExpr] = []
+    slot: dict[int, int] = {}        # candidate -> partial key slot
+    plain_slot: dict[int, int] = {}  # group expr index -> partial key slot
+    for i, (g, cl) in enumerate(zip(agg.group_exprs, gclass)):
+        if cl is not None and cl[0] in elig:
+            ci = cl[0]
+            if ci not in slot:
+                slot[ci] = len(pkeys)
+                src = elig[ci]["key_top"]
+                pkeys.append(P.BCol(agg.child.out_dtypes[src], src,
+                                    "__lm_key"))
+        else:
+            plain_slot[i] = len(pkeys)
+            pkeys.append(g)
+    m = len(pkeys)
+    partial = P.AggregateNode(
+        child=agg.child, group_exprs=pkeys, aggs=list(partial_specs),
+        out_names=[f"__lm_k{i}" for i in range(m)] +
+                  [s.name for s in partial_specs],
+        out_dtypes=[e.dtype for e in pkeys] +
+                   [s.dtype for s in partial_specs])
+    cur2: P.PlanNode = partial
+    width = m + len(partial_specs)
+    dim_off: dict[int, int] = {}
+    for ci in sorted(slot, key=lambda c: slot[c]):
+        c = elig[ci]
+        rc = _lm_clone(c["join"].right)
+        kidx = c["kidx"]
+        cur2 = P.JoinNode(
+            cur2, rc, "inner",
+            left_keys=[P.BCol(pkeys[slot[ci]].dtype, slot[ci], "__lm_key")],
+            right_keys=[P.BCol(rc.out_dtypes[kidx], kidx,
+                               rc.out_names[kidx])],
+            residual=None, late_mat=True,
+            out_names=list(cur2.out_names) + list(rc.out_names),
+            out_dtypes=list(cur2.out_dtypes) + list(rc.out_dtypes))
+        dim_off[ci] = width
+        width += len(rc.out_names)
+    exprs: list[P.BExpr] = []
+    for i, (g, cl) in enumerate(zip(agg.group_exprs, gclass)):
+        if cl is not None and cl[0] in elig:
+            ci, gcol = cl
+            exprs.append(P.BCol(g.dtype,
+                                dim_off[ci] + (gcol - elig[ci]["off"]),
+                                p_names[i]))
+        else:
+            exprs.append(P.BCol(g.dtype, plain_slot[i], p_names[i]))
+    for j in range(len(partial_specs)):
+        exprs.append(P.BCol(p_dtypes[n + j], m + j, p_names[n + j]))
+    proj = P.ProjectNode(cur2, exprs, out_names=list(p_names),
+                         out_dtypes=list(p_dtypes))
+    return _final_builder(agg, recipes, p_names, p_dtypes)(proj)
+
+
+def _late_materialization(plan: P.PlanNode, catalog: "Catalog") -> P.PlanNode:
+    """q72-class late materialization: an aggregate over fact⋈dimension whose
+    dimension columns are consumed only as group keys regroups by the
+    dimension's surrogate join key; the (small) aggregated result then joins
+    the dimension to gather attributes, and a merge aggregate over the
+    original group values restores the exact answer. The fact-scale random-
+    access gathers materializing attribute columns before aggregation — the
+    measured 10-25 ns/element cost class dominating query72 — disappear; the
+    reference leaves this to Spark, which materializes the joined columns
+    literally (nds_power.py:124-134 runs the stock template). GPU SQL
+    engines lean on the same strategy (PAPERS.md: Accelerating Presto with
+    GPUs; Flare keeps hot loops narrow the same way)."""
+    from .streaming import substitute_nodes
+
+    for _ in range(8):
+        shared = _lm_shared_nodes(plan)
+        mapping: dict[int, P.PlanNode] = {}
+        aggs = [nd for nd in P.iter_plan_nodes(plan)
+                if isinstance(nd, P.AggregateNode)]
+        for a in aggs:
+            out = _try_late_mat(a, catalog, shared)
+            if out is not None:
+                mapping[id(a)] = out
+        if not mapping:
+            return plan
+        # innermost-first: an outer rewrite would freeze the stale original
+        # of a nested rewritten aggregate inside its replacement subtree
+        for a in aggs:
+            if id(a) not in mapping:
+                continue
+            if any(id(x) in mapping and x is not a
+                   for x in P.iter_plan_nodes(a)):
+                del mapping[id(a)]
+        if not mapping:
+            return plan
+        segs = getattr(plan, "cte_segments", None)
+        plan = substitute_nodes(plan, mapping)
+        if segs is not None and not hasattr(plan, "cte_segments"):
+            plan.cte_segments = segs
+    return plan
+
 
 def _selfjoin_distinct_rewrite(plan: P.PlanNode) -> P.PlanNode:
     """q95-class exact rewrite: a CTE like

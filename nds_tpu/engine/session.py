@@ -45,6 +45,9 @@ class Session:
         self._loaders: dict[str, Callable[[], Table]] = {}
         self._schemas: dict[str, tuple[list[str], list[str]]] = {}
         self._est_rows: dict[str, int] = {}
+        # declared single-column unique keys per table (late-materialization
+        # legality); NDS table names default from schema.UNIQUE_KEYS
+        self._unique_cols: dict[str, frozenset] = {}
         self._cache: dict[str, Table] = {}
         # optional streaming readers for out-of-core scans: name ->
         # fn(columns) yielding arrow tables/batches
@@ -107,12 +110,29 @@ class Session:
         """
         return self.config.decimal_physical == "i64"
 
+    def _set_unique_cols(self, name: str, col_names,
+                         unique_cols) -> None:
+        """Record the table's declared single-column unique keys.
+
+        None (the default) consults schema.UNIQUE_KEYS — NDS dimension
+        surrogate keys are unique by the TPC-DS spec, so warehouse/power
+        registrations get them automatically; an explicit tuple (possibly
+        empty) overrides, so synthetic tables opt in or out deliberately."""
+        if unique_cols is None:
+            from ..schema import UNIQUE_KEYS
+            unique_cols = UNIQUE_KEYS.get(name, ())
+        have = set(col_names)
+        self._unique_cols[name] = frozenset(
+            c for c in unique_cols if c in have)
+
     # -- registration -------------------------------------------------------
     def register_arrow(self, name: str, table: pa.Table,
-                       est_rows: Optional[int] = None) -> None:
+                       est_rows: Optional[int] = None,
+                       unique_cols: Optional[tuple] = None) -> None:
         dec = self._dec_as_int()
         names, dtypes = arrow_bridge.engine_schema(table.schema, dec)
         self._schemas[name] = (names, dtypes)
+        self._set_unique_cols(name, names, unique_cols)
         self._est_rows[name] = est_rows if est_rows is not None else table.num_rows
         self._loaders[name] = lambda columns=None, t=table, dec=dec: \
             arrow_bridge.from_arrow(t.select(list(columns)) if columns else t,
@@ -125,7 +145,8 @@ class Session:
         self._generation += 1
 
     def register_parquet(self, name: str, path: str,
-                         est_rows: Optional[int] = None) -> None:
+                         est_rows: Optional[int] = None,
+                         unique_cols: Optional[tuple] = None) -> None:
         """Register a parquet file or partitioned directory as a table."""
         dataset = pa_dataset.dataset(path, format="parquet",
                                      partitioning="hive")
@@ -133,6 +154,7 @@ class Session:
         dec = self._dec_as_int()
         names, dtypes = arrow_bridge.engine_schema(schema, dec)
         self._schemas[name] = (names, dtypes)
+        self._set_unique_cols(name, names, unique_cols)
         if est_rows is None:
             est_rows = dataset.count_rows()
         self._est_rows[name] = est_rows
@@ -151,7 +173,8 @@ class Session:
 
     def register_csv(self, name: str, path: str, schema: pa.Schema,
                      est_rows: Optional[int] = None,
-                     delimiter: str = "|") -> None:
+                     delimiter: str = "|",
+                     unique_cols: Optional[tuple] = None) -> None:
         """Register a pipe-delimited file or directory of files lazily
         (the reference registers raw CSV as Spark temp views with explicit
         schema, nds_power.py:78-105)."""
@@ -162,6 +185,7 @@ class Session:
         dec = self._dec_as_int()
         names, dtypes = arrow_bridge.engine_schema(schema, dec)
         self._schemas[name] = (names, dtypes)
+        self._set_unique_cols(name, names, unique_cols)
         self._est_rows[name] = est_rows if est_rows is not None else 10000
 
         def load(columns=None, files=tuple(files), schema=schema, dec=dec):
@@ -195,10 +219,12 @@ class Session:
         self._generation += 1
 
     def register_view(self, name: str, table: Table,
-                      dtypes: Optional[list[str]] = None) -> None:
+                      dtypes: Optional[list[str]] = None,
+                      unique_cols: Optional[tuple] = None) -> None:
         """Register an engine Table (e.g. a temp view) directly."""
         dts = dtypes or [c.dtype for c in table.columns]
         self._schemas[name] = (list(table.names), dts)
+        self._set_unique_cols(name, table.names, unique_cols)
         self._est_rows[name] = table.num_rows
         self._loaders[name] = lambda columns=None, t=table: \
             t if columns is None else t.select(list(columns))
@@ -212,6 +238,7 @@ class Session:
         self._batch_sources.pop(name, None)
         self._drop_cached(name)
         self._est_rows.pop(name, None)
+        self._unique_cols.pop(name, None)
         self._generation += 1
 
     def table_names(self) -> list[str]:
@@ -276,7 +303,10 @@ class Session:
     def _catalog(self) -> Catalog:
         return Catalog({name: (sch[0], sch[1], self._est_rows.get(name, 1000))
                         for name, sch in self._schemas.items()},
-                       dec_enabled=self._dec_as_int())
+                       dec_enabled=self._dec_as_int(),
+                       unique_cols=dict(self._unique_cols),
+                       late_mat=self.config.late_materialization,
+                       late_mat_min_rows=self.config.late_mat_min_rows)
 
     def sql(self, query: str, backend: Optional[str] = None) -> Table:
         """Run a query; backend "jax" (device) or "numpy" (host oracle).
@@ -348,6 +378,7 @@ class Session:
         mapping: dict = {}
         total_morsels = 0
         re_records = 0
+        prefetch_errs: list[str] = []
         from .plan import MaterializedNode
         for job in jobs:
             partials = []
@@ -362,7 +393,7 @@ class Session:
                         self._incore_partial(sent["exec"], branch)))
                     continue
                 out = self._stream_branch(branch, sent["exec"], state,
-                                          partials, job)
+                                          partials, job, prefetch_errs)
                 if out is None:
                     self._stream_cache[query] = None
                     return None     # not device-runnable: in-core path
@@ -401,6 +432,11 @@ class Session:
                                 "morsels": total_morsels,
                                 "morsel_rows": self.config.chunk_rows,
                                 "re_records": re_records}
+        if prefetch_errs:
+            # prefetch failures degrade to synchronous staging — correct but
+            # slower; surface them so the degradation is observable
+            self.last_exec_stats["prefetch_errors"] = len(prefetch_errs)
+            self.last_exec_stats["prefetch_error"] = prefetch_errs[0]
         return result
 
     def _new_stream_executor(self) -> dict:
@@ -452,7 +488,7 @@ class Session:
         return arrow_bridge.to_arrow(out)
 
     def _stream_branch(self, branch, shared: dict, state: dict,
-                       partials: list, job):
+                       partials: list, job, prefetch_errs: list):
         """Morsel loop for one branch; uploads are double-buffered (a
         worker thread packs + stages morsel i+1 while the device runs
         morsel i — the tunnel charges a fixed RTT per transfer, so overlap
@@ -460,8 +496,11 @@ class Session:
         arrow tables to `partials`, compacting IN the loop whenever the
         accumulated rows outgrow stream_compact_rows (q4-class
         customer-grained groups at SF100 would otherwise peak host memory
-        before any compaction ran). Returns (morsels, re_records) or None
-        when the branch is not device-runnable."""
+        before any compaction ran). Worker-thread staging failures are
+        recorded into `prefetch_errs` (the morsel restages synchronously —
+        a silent degradation otherwise, ADVICE r5). Returns
+        (morsels, re_records) or None when the branch is not
+        device-runnable."""
         import threading
 
         from . import streaming
@@ -513,12 +552,19 @@ class Session:
                 if "buf" in staged:
                     buf = staged.pop("buf")
                 else:
+                    err = staged.pop("err", None)
+                    if err is not None:
+                        prefetch_errs.append(
+                            f"{type(err).__name__}: {err}")
                     buf = stage(morsel)
                 nxt = next(it, None)
                 if nxt is not None:
                     # stage the NEXT morsel concurrently with this run
                     def work(m=nxt):
-                        staged["buf"] = stage(m)
+                        try:
+                            staged["buf"] = stage(m)
+                        except BaseException as e:  # surfaced via prefetch_errs
+                            staged["err"] = e
                     stage_thread = threading.Thread(target=work, daemon=True)
                     stage_thread.start()
                 prev = jexec._scan_cache.get(mkey)

@@ -163,7 +163,9 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
                      precompile: bool = True,
                      query_timeout: float | None = None,
                      query_attempts: int | None = None,
-                     resume: bool = False) -> list[tuple[str, int, int, int]]:
+                     resume: bool = False,
+                     late_mat: bool | None = None
+                     ) -> list[tuple[str, int, int, int]]:
     """Run every query in the stream; returns (name, start_ms, end_ms, ms).
 
     The CSV time log layout (query name, start, end, elapsed + the
@@ -202,6 +204,8 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
     config = EngineConfig.from_property_file(property_file)
     from .config import apply_decimal
     apply_decimal(config, decimal)
+    if late_mat is not None:     # --no_late_mat A/B override
+        config.late_materialization = late_mat
     session = Session(config)
     setup_tables(session, input_prefix, input_format)
 
@@ -432,6 +436,11 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--resume", action="store_true",
                    help="skip queries already recorded in the existing "
                         "(partial) time log and keep its Power Start Time")
+    p.add_argument("--no_late_mat", action="store_true",
+                   help="disable the late-materialization planner rewrite "
+                        "(group by surrogate keys, gather dimension "
+                        "attributes after aggregation) for A/B runs; "
+                        "property: nds.tpu.late_materialization")
     a = p.parse_args(argv)
     sub = a.sub_queries.split(",") if a.sub_queries else None
     inject = a.fault_inject.split(",") if a.fault_inject else None
@@ -442,7 +451,8 @@ def main(argv: list[str] | None = None) -> int:
                      profile_folder=a.profile_folder, fault_inject=inject,
                      decimal=a.decimal, precompile=not a.no_precompile,
                      query_timeout=a.query_timeout, query_attempts=a.retry,
-                     resume=a.resume)
+                     resume=a.resume,
+                     late_mat=False if a.no_late_mat else None)
     return 0
 
 

@@ -430,6 +430,34 @@ _MAINTENANCE_TABLES: tuple[TableSchema, ...] = (
 )
 
 
+# Single-column unique keys (TPC-DS spec §2 primary keys): every dimension
+# table's surrogate key is unique; fact, returns, and inventory tables have
+# COMPOSITE primary keys and deliberately list nothing here (inv_date_sk is
+# the first column of inventory but repeats per item/warehouse). Consumed by
+# the planner's late-materialization legality analysis: a join against one of
+# these keys is provably 1:1 per matched probe row, so dimension attributes
+# may be gathered after aggregation.
+UNIQUE_KEYS: dict[str, tuple[str, ...]] = {
+    "customer_address": ("ca_address_sk",),
+    "customer_demographics": ("cd_demo_sk",),
+    "date_dim": ("d_date_sk",),
+    "warehouse": ("w_warehouse_sk",),
+    "ship_mode": ("sm_ship_mode_sk",),
+    "time_dim": ("t_time_sk",),
+    "reason": ("r_reason_sk",),
+    "income_band": ("ib_income_band_sk",),
+    "item": ("i_item_sk",),
+    "store": ("s_store_sk",),
+    "call_center": ("cc_call_center_sk",),
+    "customer": ("c_customer_sk",),
+    "web_site": ("web_site_sk",),
+    "household_demographics": ("hd_demo_sk",),
+    "web_page": ("wp_web_page_sk",),
+    "promotion": ("p_promo_sk",),
+    "catalog_page": ("cp_catalog_page_sk",),
+}
+
+
 @lru_cache(maxsize=None)
 def get_schemas(use_decimal: bool = True) -> dict[str, TableSchema]:
     """All 24 source-table schemas, keyed by table name.

@@ -345,6 +345,10 @@ class Warehouse:
             dec = session._dec_as_int()
             names, dtypes = arrow_bridge.engine_schema(dataset.schema, dec)
             session._schemas[name] = (names, dtypes)
+            # NDS dimension surrogate keys are unique by spec: declare them
+            # so the late-materialization legality analysis sees warehouse
+            # registrations exactly like register_parquet ones
+            session._set_unique_cols(name, names, None)
             session._est_rows[name] = (est_rows or {}).get(
                 name, dataset.count_rows())
 

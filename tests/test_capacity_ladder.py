@@ -1,0 +1,71 @@
+"""Finer capacity ladder for big buffers (device.bucket): 3*2^(k-1) steps
+between powers of two above CAP_LADDER_MIN rows (PERF.md r5 headroom #2 —
+expansion caps averaged 1.5x the actual row count, and gather cost scales
+with CAP). Below the threshold the ladder stays pure powers of two, so small
+shape buckets — the compile-cache-friendly regime — are untouched."""
+import pytest
+
+from nds_tpu.engine.jax_backend.device import CAP_LADDER_MIN, bucket
+from nds_tpu.engine.jax_backend.executor import (ReplayMismatch,
+                                                 _verify_schedule)
+
+M = 1 << 20
+
+
+def test_small_counts_stay_powers_of_two():
+    assert bucket(0) == 8 and bucket(1) == 8          # minimum
+    assert bucket(9) == 16
+    assert bucket(1000) == 1024
+    assert bucket(CAP_LADDER_MIN) == CAP_LADDER_MIN   # 4M: last pure-pow2 cap
+
+
+def test_midpoints_above_threshold():
+    assert bucket(4 * M + 1) == 6 * M
+    assert bucket(5 * M) == 6 * M
+    assert bucket(6 * M) == 6 * M                     # idempotent on-cap
+    assert bucket(6 * M + 1) == 8 * M
+    assert bucket(8 * M) == 8 * M
+    assert bucket(9 * M) == 12 * M
+    assert bucket(12 * M + 1) == 16 * M
+    assert bucket(17 * M) == 24 * M
+
+
+def test_ladder_is_monotone_and_idempotent():
+    prev = 0
+    for n in range(1, 30 * M, 997 * 131):             # coarse sweep
+        c = bucket(n)
+        assert c >= n and c >= prev
+        assert bucket(c) == c
+        prev = c
+
+
+def test_overshoot_bounded():
+    # above the threshold the cap overshoots by at most 1.5x (was 2x);
+    # gather cost scales with CAP, so this bounds the wasted traffic
+    for n in range(CAP_LADDER_MIN + 1, 64 * M, 999 * 1009):
+        assert bucket(n) / n <= 1.5
+
+
+def test_mesh_divisibility_preserved():
+    # midpoint caps keep every power-of-two shard count up to 2^(k-1)
+    for shards in (2, 4, 8, 16):
+        assert (6 * M) % shards == 0
+        assert (12 * M) % shards == 0
+
+
+def test_schedule_check_accepts_growth_within_ladder_cap():
+    """Recompile-count bound: row counts drifting within one ladder step
+    replay against the recorded program — only crossing the (now 1.5x-max)
+    cap forces a re-record."""
+    decisions = [("cap", 5 * M)]                       # planned: caps at 6M
+    _verify_schedule(decisions, [5 * M + 100_000])     # growth inside cap
+    _verify_schedule(decisions, [6 * M])               # exactly at cap
+    with pytest.raises(ReplayMismatch):
+        _verify_schedule(decisions, [6 * M + 1])       # crossed: re-record
+
+
+def test_one_program_shape_per_ladder_band():
+    caps = {bucket(n) for n in range(4 * M + 1, 6 * M, 65_536)}
+    assert caps == {6 * M}
+    caps = {bucket(n) for n in range(6 * M + 1, 8 * M, 65_536)}
+    assert caps == {8 * M}

@@ -1,0 +1,325 @@
+"""Late materialization (planner._late_materialization): aggregate-over-join
+plans whose dimension columns are consumed only as group keys regroup by the
+dimension's surrogate join key and gather the attributes AFTER aggregation
+(the q72-class fix: 16M-row random-access gathers materializing joined
+dimension columns before the group-by, PERF.md r5 headroom #1).
+
+Exactness is pinned three ways: against an independent SQLite oracle over
+the same rows, against the engine's own un-rewritten plan (the
+NDS_TPU_NO_LATE_MAT A/B toggle), and numpy-vs-jax. Guard rails: ineligible
+shapes — attributes consumed pre-aggregation, non-unique keys, computed
+group expressions — must provably keep their original plans."""
+import math
+import os
+import sqlite3
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import nds_tpu.engine.plan as P
+from nds_tpu.config import EngineConfig
+from nds_tpu.engine import Session
+from nds_tpu.engine.planner import Planner
+from nds_tpu.sql import parse_sql
+
+FACT_EST = 5_000_000     # claimed estimate: clears the late_mat_min_rows gate
+
+
+def _tables(seed=3, n=3000, nd=48):
+    rng = np.random.default_rng(seed)
+    amt = rng.integers(1, 100, n).astype(object)
+    amt[rng.random(n) < 0.1] = None          # NULLs exercise sum_guarded
+    key = rng.integers(0, nd + 4, n)         # keys 48..51 miss the dimension
+    fact = pa.table({
+        "f_key": pa.array(key, type=pa.int64()),
+        "f_cat": pa.array(rng.integers(0, 4, n), type=pa.int64()),
+        "f_amt": pa.array(amt, type=pa.int64()),
+        "f_price": pa.array(np.round(rng.random(n) * 10, 2),
+                            type=pa.float64()),
+    })
+    attr = (np.arange(nd) % 7).astype(object)
+    attr[5] = None                           # a NULL attribute value
+    dim = pa.table({
+        "d_key": pa.array(np.arange(nd), type=pa.int64()),
+        "d_attr": pa.array(attr, type=pa.int64()),
+        "d_name": pa.array([f"name{i % 5}" for i in range(nd)]),
+    })
+    return {"fact": fact, "dim": dim}
+
+
+def _session(tables, declare_unique=True, config=None):
+    s = Session(config)
+    s.register_arrow("fact", tables["fact"], est_rows=FACT_EST)
+    s.register_arrow("dim", tables["dim"],
+                     unique_cols=("d_key",) if declare_unique else ())
+    return s
+
+
+def _sqlite(tables):
+    conn = sqlite3.connect(":memory:")
+    for name, t in tables.items():
+        cols = ", ".join(f'"{c}"' for c in t.column_names)
+        conn.execute(f"CREATE TABLE {name} ({cols})")
+        rows = list(zip(*[t.column(c).to_pylist() for c in t.column_names]))
+        conn.executemany(
+            f"INSERT INTO {name} VALUES ({','.join('?' * len(t.column_names))})",
+            rows)
+    conn.commit()
+    return conn
+
+
+def _rows_equal(got, want):
+    if len(got) != len(want):
+        return False
+    for g, w in zip(got, want):
+        if len(g) != len(w):
+            return False
+        for a, b in zip(g, w):
+            if isinstance(a, float) or isinstance(b, float):
+                if a is None or b is None:
+                    if a is not b:
+                        return False
+                elif not math.isclose(float(a), float(b), rel_tol=1e-6,
+                                      abs_tol=1e-9):
+                    return False
+            elif a != b:
+                return False
+    return True
+
+
+def _late_joins(plan):
+    return [x for x in P.iter_plan_nodes(plan)
+            if isinstance(x, P.JoinNode) and getattr(x, "late_mat", False)]
+
+
+def _plan(session, q):
+    return Planner(session._catalog()).plan_query(parse_sql(q))
+
+
+def _check(q, tables=None, fires=True, declare_unique=True, config=None):
+    """Plan-inspect + three-way differential (sqlite / numpy / jax)."""
+    tables = tables or _tables()
+    s = _session(tables, declare_unique, config)
+    plan = _plan(s, q)
+    if fires:
+        assert _late_joins(plan), "late-materialization must fire"
+    else:
+        assert not _late_joins(plan), "plan must stay original"
+    want = _sqlite(tables).execute(q).fetchall()
+    got_np = s.sql(q, backend="numpy").to_pylist()
+    assert _rows_equal(got_np, want), (got_np[:5], want[:5])
+    got_jx = s.sql(q, backend="jax").to_pylist()
+    assert _rows_equal(got_jx, want), (got_jx[:5], want[:5])
+    return plan
+
+
+# -- eligible shapes ---------------------------------------------------------
+
+def test_group_key_only_counts():
+    _check("SELECT d_attr, COUNT(*) AS cnt FROM fact, dim "
+           "WHERE f_key = d_key GROUP BY d_attr ORDER BY d_attr")
+
+
+def test_sum_min_max_avg_merge_exactly():
+    _check("SELECT d_attr, SUM(f_amt) AS s, MIN(f_amt) AS mn, "
+           "MAX(f_amt) AS mx, AVG(f_price) AS a, COUNT(f_amt) AS c "
+           "FROM fact, dim WHERE f_key = d_key "
+           "GROUP BY d_attr ORDER BY d_attr")
+
+
+def test_string_attribute_group_key():
+    _check("SELECT d_name, SUM(f_amt) AS s FROM fact, dim "
+           "WHERE f_key = d_key GROUP BY d_name ORDER BY d_name")
+
+
+def test_post_agg_projection_and_having():
+    _check("SELECT d_name, COUNT(*) AS cnt FROM fact, dim "
+           "WHERE f_key = d_key GROUP BY d_name "
+           "HAVING COUNT(*) > 100 ORDER BY d_name")
+
+
+def test_mixed_fact_and_dim_group_keys():
+    _check("SELECT d_attr, f_cat, COUNT(*) AS cnt FROM fact, dim "
+           "WHERE f_key = d_key GROUP BY d_attr, f_cat "
+           "ORDER BY d_attr, f_cat")
+
+
+def test_group_by_key_and_attr():
+    # the surrogate key itself in the group list rides along exactly
+    _check("SELECT d_key, d_attr, COUNT(*) AS cnt FROM fact, dim "
+           "WHERE f_key = d_key GROUP BY d_key, d_attr "
+           "ORDER BY d_key")
+
+
+def test_duplicate_attr_values_re_merge():
+    """Distinct surrogate keys sharing one attribute value must merge into
+    ONE output group — the merge aggregate, not key-grouping alone, is what
+    keeps the rewrite exact (48 keys fold to 7 d_attr groups)."""
+    q = ("SELECT d_attr, COUNT(*) AS cnt, SUM(f_amt) AS s FROM fact, dim "
+         "WHERE f_key = d_key GROUP BY d_attr ORDER BY d_attr")
+    plan = _check(q)
+    aggs = [x for x in P.iter_plan_nodes(plan)
+            if isinstance(x, P.AggregateNode)]
+    assert len(aggs) == 2, "partial (by key) + merge (by attribute)"
+
+
+def test_empty_result_through_rewrite():
+    _check("SELECT d_attr, COUNT(*) AS cnt, SUM(f_amt) AS s "
+           "FROM fact, dim WHERE f_key = d_key AND f_cat = 99 "
+           "GROUP BY d_attr ORDER BY d_attr")
+
+
+def test_fact_filter_still_eligible():
+    # a pre-agg filter on FACT columns does not pin the dimension
+    _check("SELECT d_attr, COUNT(*) AS cnt FROM fact, dim "
+           "WHERE f_key = d_key AND f_cat = 2 "
+           "GROUP BY d_attr ORDER BY d_attr")
+
+
+def test_q72_shape_two_dims_deferred():
+    """The query72 shape: fact joins several dimensions; attribute group
+    keys defer per-dimension, a dimension consumed by a pre-agg filter
+    stays pinned."""
+    tables = _tables()
+    rng = np.random.default_rng(9)
+    nd2 = 12
+    tables = dict(tables)
+    tables["wh"] = pa.table({
+        "w_key": pa.array(np.arange(nd2), type=pa.int64()),
+        "w_name": pa.array([f"wh{i % 3}" for i in range(nd2)]),
+    })
+    tables["dd"] = pa.table({
+        "dd_key": pa.array(np.arange(30), type=pa.int64()),
+        "dd_week": pa.array(np.arange(30) // 7, type=pa.int64()),
+    })
+    n = tables["fact"].num_rows
+    tables["fact"] = tables["fact"].append_column(
+        "f_wh", pa.array(rng.integers(0, nd2, n), type=pa.int64()))
+    tables["fact"] = tables["fact"].append_column(
+        "f_date", pa.array(rng.integers(0, 30, n), type=pa.int64()))
+    q = ("SELECT d_attr, w_name, COUNT(*) AS cnt FROM fact, dim, wh, dd "
+         "WHERE f_key = d_key AND f_wh = w_key AND f_date = dd_key "
+         "AND dd_week >= 1 "
+         "GROUP BY d_attr, w_name ORDER BY d_attr, w_name")
+    s = Session()
+    s.register_arrow("fact", tables["fact"], est_rows=FACT_EST)
+    s.register_arrow("dim", tables["dim"], unique_cols=("d_key",))
+    s.register_arrow("wh", tables["wh"], unique_cols=("w_key",))
+    s.register_arrow("dd", tables["dd"], unique_cols=("dd_key",))
+    plan = _plan(s, q)
+    assert len(_late_joins(plan)) == 2, \
+        "dim and wh defer; dd contributes no attribute group key"
+    want = _sqlite(tables).execute(q).fetchall()
+    assert _rows_equal(s.sql(q, backend="numpy").to_pylist(), want)
+    assert _rows_equal(s.sql(q, backend="jax").to_pylist(), want)
+
+
+def test_compiled_replay_matches():
+    """Second jax execution replays the compiled program over the rewritten
+    plan; results must be identical both times."""
+    tables = _tables()
+    s = _session(tables)
+    q = ("SELECT d_attr, COUNT(*) AS cnt, SUM(f_amt) AS s FROM fact, dim "
+         "WHERE f_key = d_key GROUP BY d_attr ORDER BY d_attr")
+    first = s.sql(q, backend="jax").to_pylist()
+    second = s.sql(q, backend="jax").to_pylist()
+    assert first == second
+    assert s.last_exec_stats.get("mode") in ("compiled", "compile+run")
+
+
+# -- ineligible shapes keep their original plans ------------------------------
+
+def test_pushed_down_dim_filter_still_eligible():
+    """A dim-only predicate is pushed INTO the dimension unit by the
+    planner: it clones with the dimension, so deferral stays exact (the
+    attribute never materializes at fact scale either way)."""
+    _check("SELECT d_attr, COUNT(*) AS cnt FROM fact, dim "
+           "WHERE f_key = d_key AND d_attr > 2 "
+           "GROUP BY d_attr ORDER BY d_attr")
+
+
+def test_attr_in_pre_agg_filter_ineligible():
+    """A mixed fact/dim predicate cannot push into either unit: it consumes
+    the attribute ABOVE the join, pre-aggregation, and pins the dimension."""
+    _check("SELECT d_attr, COUNT(*) AS cnt FROM fact, dim "
+           "WHERE f_key = d_key AND d_attr > f_cat "
+           "GROUP BY d_attr ORDER BY d_attr", fires=False)
+
+
+def test_attr_in_agg_arg_ineligible():
+    _check("SELECT d_attr, SUM(f_amt + d_attr) AS s FROM fact, dim "
+           "WHERE f_key = d_key GROUP BY d_attr ORDER BY d_attr",
+           fires=False)
+
+
+def test_computed_group_expr_ineligible():
+    _check("SELECT d_attr + 1 AS a1, COUNT(*) AS cnt FROM fact, dim "
+           "WHERE f_key = d_key GROUP BY d_attr + 1 ORDER BY a1",
+           fires=False)
+
+
+def test_undeclared_key_uniqueness_ineligible():
+    # without catalog uniqueness the post-agg join could double-count:
+    # the legality analysis must refuse
+    _check("SELECT d_attr, COUNT(*) AS cnt FROM fact, dim "
+           "WHERE f_key = d_key GROUP BY d_attr ORDER BY d_attr",
+           fires=False, declare_unique=False)
+
+
+def test_distinct_agg_ineligible():
+    _check("SELECT d_attr, COUNT(DISTINCT f_cat) AS c FROM fact, dim "
+           "WHERE f_key = d_key GROUP BY d_attr ORDER BY d_attr",
+           fires=False)
+
+
+def test_small_plans_keep_original_shape():
+    # default est_rows (actual tiny row counts) sits under the size gate
+    tables = _tables()
+    s = Session()
+    s.register_arrow("fact", tables["fact"])
+    s.register_arrow("dim", tables["dim"], unique_cols=("d_key",))
+    q = ("SELECT d_attr, COUNT(*) AS cnt FROM fact, dim "
+         "WHERE f_key = d_key GROUP BY d_attr")
+    assert not _late_joins(_plan(s, q))
+
+
+# -- opt-outs -----------------------------------------------------------------
+
+def test_env_toggle_disables():
+    tables = _tables()
+    os.environ["NDS_TPU_NO_LATE_MAT"] = "1"
+    try:
+        s = _session(tables)
+        q = ("SELECT d_attr, COUNT(*) AS cnt FROM fact, dim "
+             "WHERE f_key = d_key GROUP BY d_attr")
+        assert not _late_joins(_plan(s, q))
+    finally:
+        del os.environ["NDS_TPU_NO_LATE_MAT"]
+
+
+def test_config_toggle_disables_and_matches():
+    tables = _tables()
+    cfg = EngineConfig(late_materialization=False)
+    q = ("SELECT d_attr, SUM(f_amt) AS s FROM fact, dim "
+         "WHERE f_key = d_key GROUP BY d_attr ORDER BY d_attr")
+    s_off = _session(tables, config=cfg)
+    assert not _late_joins(_plan(s_off, q))
+    s_on = _session(tables)
+    assert _late_joins(_plan(s_on, q))
+    assert s_on.sql(q, backend="numpy").to_pylist() == \
+        s_off.sql(q, backend="numpy").to_pylist()
+
+
+def test_nds_dimension_keys_auto_declared():
+    """NDS table names pick up schema.UNIQUE_KEYS without any declaration."""
+    s = Session()
+    item = pa.table({
+        "i_item_sk": pa.array(np.arange(10), type=pa.int64()),
+        "i_item_desc": pa.array([f"d{i % 3}" for i in range(10)]),
+    })
+    s.register_arrow("item", item)
+    assert s._unique_cols["item"] == frozenset({"i_item_sk"})
+    s.register_arrow("store_sales", pa.table({
+        "ss_item_sk": pa.array([1, 2], type=pa.int64())}))
+    assert s._unique_cols["store_sales"] == frozenset()

@@ -5,10 +5,12 @@
 # Stages:
 #   native     - build the C++ data generator and self-check one tiny table
 #   resilience - fast smoke of the fault-injection/retry/deadline layer
-#   planner    - late-materialization legality/differential + capacity-ladder
-#                tests (fast, CPU backend): the rewrite changes plans for
-#                every dimension-grouped aggregate, so its SQLite-oracle
-#                exactness gate runs early and cheaply
+#   planner    - planner/streaming tier-1: late-materialization legality/
+#                differential, capacity-ladder, and shared-scan morsel
+#                fusion tests (fast, CPU backend): these rewrites change
+#                plans/execution for every dimension-grouped aggregate and
+#                every streamed query, so their SQLite-oracle exactness
+#                gates run early and cheaply
 #   test       - full pytest suite on an 8-virtual-device CPU mesh
 #   bench      - quick bench slice (SF 0.01) to catch perf regressions early
 #   all        - every stage in order
@@ -43,7 +45,8 @@ stage_resilience() {
 
 stage_planner() {
     (cd "$REPO" && python -m pytest tests/test_late_materialization.py \
-        tests/test_capacity_ladder.py -q)
+        tests/test_capacity_ladder.py tests/test_shared_scan.py \
+        tests/test_streaming.py -q)
 }
 
 stage_test() {

@@ -47,6 +47,21 @@ class EngineConfig:
     # (partial-schema-preserving re-aggregation): bounds host memory when
     # group cardinality is large (customer-grained q4-class aggregates)
     stream_compact_rows: int = 8_000_000
+    # shared-scan morsel fusion: ALL streaming branches of one query that
+    # scan the same big table share ONE morsel pass — the union of their
+    # pruned column sets packs/uploads once per morsel and each branch reads
+    # its subset as zero-copy views of the staged buffer. q9-class plans
+    # carry 15 scalar-subquery jobs over store_sales; without sharing the
+    # dominant scan+upload cost is paid 15 times per query. Property:
+    # nds.tpu.shared_scan; the power runner exposes --no_shared_scan for A/B.
+    shared_scan: bool = True
+    # fuse a shared-scan group's per-branch partial programs into a single
+    # multi-output per-morsel XLA program (the fixed per-dispatch tunnel RTT
+    # is then paid once per morsel, not once per branch per morsel) when the
+    # group has at most this many branches; larger groups keep per-branch
+    # programs over the shared staged buffer (bounded compile time).
+    # 0 = fuse unconditionally.
+    stream_fusion_max_branches: int = 16
     # late materialization for join-heavy aggregates (planner.
     # _late_materialization): group by the dimension's surrogate join key and
     # gather dimension attributes AFTER aggregation instead of materializing

@@ -258,6 +258,18 @@ def unpack_table(p: PackedTable) -> DTable:
     return DTable(list(p.names), cols, p.masks[len(p.dtypes)])
 
 
+def device_bytes(dt: "Optional[DTable | PackedTable]") -> int:
+    """Device bytes held by a table (DTable or PackedTable — any pytree of
+    device arrays). Streaming uses it to account uploaded morsel bytes
+    (last_exec_stats.bytes_uploaded): on tunneled platforms upload volume
+    is the cost the shared scan divides by the branch count."""
+    if dt is None:
+        return 0
+    return sum(int(leaf.size) * leaf.dtype.itemsize
+               for leaf in jax.tree_util.tree_leaves(dt)
+               if hasattr(leaf, "size") and hasattr(leaf, "dtype"))
+
+
 def free_dtable(dt: "Optional[DTable | PackedTable]") -> None:
     """Explicitly release a cached entry's device buffers (DTable or
     PackedTable — any pytree of device arrays).

@@ -109,9 +109,15 @@ class CompiledQuery:
     literals as a parameter vector: the traced program is therefore
     byte-identical across streams/seeds of one template (same structure,
     same capacities), and the persistent XLA cache serves every stream
-    after the first compile."""
+    after the first compile.
 
-    def __init__(self, plan: PlanNode, decisions: list, scan_keys: tuple,
+    `plan` may be a LIST of plans (shared-scan fused morsel groups,
+    streaming.fuse_group): the plans trace in order under ONE decision
+    schedule — recorded by JaxExecutor.record_plans — into one multi-output
+    program, and run() returns a tuple of DTables. The fixed per-dispatch
+    tunnel RTT is then paid once per morsel instead of once per branch."""
+
+    def __init__(self, plan, decisions: list, scan_keys: tuple,
                  mesh=None, param_dtypes: tuple = (),
                  shard_min_rows: int = 1 << 18):
         self.plan = plan
@@ -138,7 +144,17 @@ class CompiledQuery:
         ex = JaxExecutor(_no_load, recorder=rec, scan_tables=scans,
                          mesh=self.mesh, params=params,
                          shard_min_rows=self.shard_min_rows)
-        out = ex.execute(self.plan)
+        if isinstance(self.plan, (list, tuple)):
+            outs = []
+            for p in self.plan:
+                # memo resets between member plans, mirroring the per-plan
+                # record passes (record_plans) so both consume the shared
+                # decision schedule identically
+                ex._memo = {}
+                outs.append(ex.execute(p))
+            out = tuple(outs)
+        else:
+            out = ex.execute(self.plan)
         if rec.idx != len(rec.decisions):
             raise NotJittable("decision schedule length drift")
         if ex.fallback_nodes:
@@ -834,6 +850,29 @@ class JaxExecutor:
             self._rec = None
             self._params = old_params
         return out, rec.decisions, tuple(self._touched_scans)
+
+    def record_plans(self, plans: list, params: tuple = ()):
+        """Record several plans under ONE shared decision schedule (shared-
+        scan fused morsel groups): the plans run in order with a single
+        recorder, and the memo resets per plan exactly like the multi-plan
+        replay in CompiledQuery._trace. Returns (outs, decisions,
+        scan_keys) — scan_keys is the union in first-touch order across
+        plans, so the fused program's argument order is deterministic."""
+        from ...resilience import FAULTS
+        FAULTS.fire("jax.execute")
+        rec = _Recorder("record")
+        self._rec = rec
+        self._touched_scans = {}
+        old_params = self._params
+        self._params = params
+        outs = []
+        try:
+            for p in plans:
+                outs.append(self._eager(p))
+        finally:
+            self._rec = None
+            self._params = old_params
+        return outs, rec.decisions, tuple(self._touched_scans)
 
     def _load_columns(self, table: str, columns) -> Table:
         from ..executor import load_columns

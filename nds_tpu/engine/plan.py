@@ -204,6 +204,21 @@ class VirtualScanNode(PlanNode):
     label: str = ""
 
 
+def column_view(child: PlanNode, indices: list[int], out_names: list[str],
+                out_dtypes: list[str]) -> "ProjectNode":
+    """A pure column-selection projection over `child` (BCol references
+    only): both executors evaluate it as column picking with no data
+    movement — inside a compiled device program the selection fuses away
+    entirely. Shared-scan morsel fusion builds these to hand each branch
+    its pruned subset of the staged union-column buffer as zero-copy
+    views."""
+    return ProjectNode(
+        child,
+        [BCol(child.out_dtypes[i], i, n)
+         for i, n in zip(indices, out_names)],
+        out_names=list(out_names), out_dtypes=list(out_dtypes))
+
+
 def walk(node: PlanNode):
     """Pre-order traversal of a plan tree."""
     yield node

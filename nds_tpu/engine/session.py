@@ -62,10 +62,13 @@ class Session:
         self._jax_exec = None
         self._jax_exec_gen = -1
         # out-of-core: per-query streaming state (rewritten plan + compiled
-        # morsel program + executor with its scan cache); None = known
-        # not-streamable. Invalidated when the catalog generation moves.
+        # morsel programs + executor with its scan cache); None = known
+        # not-streamable. Invalidated when the catalog generation moves OR
+        # any streaming-relevant config field changes (_stream_config_key):
+        # cached plans/sentinels embed late_materialization, chunk_rows,
+        # shared_scan..., so a live-session toggle must not replay them.
         self._stream_cache: dict[str, Optional[dict]] = {}
-        self._stream_cache_gen = -1
+        self._stream_cache_cfg: Optional[tuple] = None
 
     def _device_mesh(self):
         """Build the SPMD mesh from config.mesh_shape (None = single device).
@@ -255,6 +258,13 @@ class Session:
         to per-file reads."""
         import pyarrow as pa
 
+        def flush(pending):
+            # a single pending slice (aligned source batches, the common
+            # parquet row-group case) passes through zero-copy — concat
+            # would re-chunk and copy for nothing
+            return pending[0] if len(pending) == 1 \
+                else pa.concat_tables(pending)
+
         def emit(batches):
             """Re-chunk a stream of arrow tables into `rows`-sized morsels."""
             pending: list[pa.Table] = []
@@ -268,10 +278,10 @@ class Session:
                     t = t.slice(take)
                     count += take
                     if count == rows:
-                        yield pa.concat_tables(pending)
+                        yield flush(pending)
                         pending, count = [], 0
             if pending:
-                yield pa.concat_tables(pending)
+                yield flush(pending)
 
         src = self._batch_sources.get(name)
         if src is not None:
@@ -336,12 +346,30 @@ class Session:
         executor = Executor(self.load_table)
         return executor.execute(plan)
 
+    def _stream_config_key(self) -> tuple:
+        """Streaming-state cache validity fingerprint: the cached rewritten
+        plans, scan groups, compiled morsel programs, and not-streamable
+        sentinels are all functions of the catalog generation AND these
+        config fields — toggling any of them on a live session (A/B runs,
+        tests) must not replay a stale entry."""
+        cfg = self.config
+        return (self._generation, cfg.out_of_core_min_rows, cfg.chunk_rows,
+                cfg.stream_compact_rows, cfg.shared_scan,
+                cfg.stream_fusion_max_branches, cfg.late_materialization,
+                cfg.late_mat_min_rows, cfg.decimal_physical, cfg.use_jax,
+                tuple(cfg.mesh_shape))
+
     def _sql_streaming(self, query: str):
-        """Out-of-core execution (generalized, round 5): every MAXIMAL
-        streamable aggregate subtree in the plan — top-level, below joins,
-        inside CTE bodies, with UNION ALL fact-channel branches — streams
-        its big scan(s) through the device in chunk_rows morsels sharing
-        one compiled program per branch; per-morsel partial aggregates
+        """Out-of-core execution (generalized round 5, shared-scan round 7):
+        every MAXIMAL streamable aggregate subtree in the plan — top-level,
+        below joins, inside CTE bodies, scalar subqueries, with UNION ALL
+        fact-channel branches — streams its big scan(s) through the device
+        in chunk_rows morsels. All branches of a query that scan the SAME
+        big table form one ScanGroup (streaming.plan_scan_groups): the
+        union of their pruned column sets uploads once per morsel and each
+        branch reads zero-copy views of the staged buffer, so q9-class
+        plans with 15 scalar-subquery jobs over store_sales pay the scan +
+        upload cost once instead of 15 times. Per-morsel partial aggregates
         merge on host (periodically compacted to bound memory for
         customer-grained groups), and a MaterializedNode replaces each
         aggregate subtree before the remaining (small) plan runs in-core.
@@ -349,9 +377,10 @@ class Session:
         power_run_gpu.template. Returns None if nothing is streamable."""
         from . import streaming
 
-        if self._stream_cache_gen != self._generation:
+        cfg_key = self._stream_config_key()
+        if self._stream_cache_cfg != cfg_key:
             self._stream_cache = {}
-            self._stream_cache_gen = self._generation
+            self._stream_cache_cfg = cfg_key
 
         sent = self._stream_cache.get(query, "miss")
         if sent is None:          # known not-streamable: skip the re-plan
@@ -364,46 +393,56 @@ class Session:
             if not jobs:
                 self._stream_cache[query] = None
                 return None
-            # ONE executor serves every branch of every job: branches run
+            groups = streaming.plan_scan_groups(jobs,
+                                                self.config.shared_scan)
+            # ONE executor serves every group of every job: groups run
             # sequentially, and sharing the scan cache uploads each
             # dimension table once instead of per branch
             shared = self._new_stream_executor()
-            sent = {"plan": plan, "jobs": jobs, "exec": shared,
-                    "states": [{"cq": None, "ent": None, "mkey": None}
-                               for job in jobs for b in job.branches]}
+            sent = {"plan": plan, "jobs": jobs, "groups": groups,
+                    "exec": shared,
+                    "gstates": [{"cqs": None, "ents": None, "fused": False}
+                                for _ in groups]}
             self._stream_cache[query] = sent
 
-        plan, jobs = sent["plan"], sent["jobs"]
-        states = iter(sent["states"])
+        plan, jobs, groups = sent["plan"], sent["jobs"], sent["groups"]
         mapping: dict = {}
         total_morsels = 0
         re_records = 0
+        bytes_uploaded = 0
+        fused_groups = 0
+        morsels_per_table: dict[str, int] = {}
         prefetch_errs: list[str] = []
         from .plan import MaterializedNode
-        for job in jobs:
-            partials = []
+        partials: list[list] = [[] for _ in jobs]
+        for ji, job in enumerate(jobs):
             for branch in job.branches:
-                state = next(states)
                 if branch.big_table is None:
                     # no big scan in this branch: one-shot in-core partial —
                     # on the DEVICE when the session runs jax (a just-under-
                     # threshold channel can still be tens of millions of
                     # rows; the host executor is the 1-core fallback)
-                    partials.append(arrow_bridge.to_arrow(
+                    partials[ji].append(arrow_bridge.to_arrow(
                         self._incore_partial(sent["exec"], branch)))
-                    continue
-                out = self._stream_branch(branch, sent["exec"], state,
-                                          partials, job, prefetch_errs)
-                if out is None:
-                    self._stream_cache[query] = None
-                    return None     # not device-runnable: in-core path
-                morsels_run, rr = out
-                total_morsels += morsels_run
-                re_records += rr
-            if not partials:
+        for group, gstate in zip(groups, sent["gstates"]):
+            sinks = [(jobs[ji], partials[ji]) for ji, _bi in group.members]
+            out = self._stream_group(group, sent["exec"], gstate, sinks,
+                                     prefetch_errs)
+            if out is None:
+                self._stream_cache[query] = None
+                return None     # not device-runnable: in-core path
+            morsels_run, rr, ub = out
+            total_morsels += morsels_run
+            re_records += rr
+            bytes_uploaded += ub
+            fused_groups += 1 if gstate["fused"] else 0
+            morsels_per_table[group.table] = \
+                morsels_per_table.get(group.table, 0) + morsels_run
+        for ji, job in enumerate(jobs):
+            if not partials[ji]:
                 self._stream_cache[query] = None
                 return None
-            merged_arrow = pa.concat_tables(partials,
+            merged_arrow = pa.concat_tables(partials[ji],
                                             promote_options="permissive")
             merged = arrow_bridge.from_arrow(merged_arrow,
                                              self._dec_as_int())
@@ -427,11 +466,23 @@ class Session:
                 mapping[id(job.agg)] = mat_node
         final_plan = streaming.substitute_nodes(plan, mapping)
         result = Executor(self.load_table).execute(final_plan)
-        self.last_exec_stats = {"mode": "streaming",
-                                "jobs": len(jobs),
-                                "morsels": total_morsels,
-                                "morsel_rows": self.config.chunk_rows,
-                                "re_records": re_records}
+        self.last_exec_stats = {
+            "mode": "streaming",
+            "jobs": len(jobs),
+            "morsels": total_morsels,
+            "morsel_rows": self.config.chunk_rows,
+            "re_records": re_records,
+            # shared-scan observability (round 7): scan_passes counts morsel
+            # loops (== tables_streamed when shared_scan serves every branch
+            # from one pass; == branches_served per-branch without it)
+            "shared_scan": bool(self.config.shared_scan),
+            "scan_passes": len(groups),
+            "tables_streamed": len(morsels_per_table),
+            "branches_served": sum(len(g.members) for g in groups),
+            "fused_groups": fused_groups,
+            "bytes_uploaded": bytes_uploaded,
+            "morsels_per_table": morsels_per_table,
+        }
         if prefetch_errs:
             # prefetch failures degrade to synchronous staging — correct but
             # slower; surface them so the degradation is observable
@@ -487,58 +538,106 @@ class Session:
         out = Executor(self.load_table).execute(job.build_combine(mat))
         return arrow_bridge.to_arrow(out)
 
-    def _stream_branch(self, branch, shared: dict, state: dict,
-                       partials: list, job, prefetch_errs: list):
-        """Morsel loop for one branch; uploads are double-buffered (a
+    def _stream_group(self, group, shared: dict, state: dict,
+                      sinks: list, prefetch_errs: list):
+        """Morsel loop for one shared-scan group: ONE morsel iterator and
+        ONE double-buffered upload per morsel serve EVERY member branch (a
         worker thread packs + stages morsel i+1 while the device runs
         morsel i — the tunnel charges a fixed RTT per transfer, so overlap
-        is the lever SF100 q3 was missing). Appends per-morsel partial
-        arrow tables to `partials`, compacting IN the loop whenever the
-        accumulated rows outgrow stream_compact_rows (q4-class
-        customer-grained groups at SF100 would otherwise peak host memory
-        before any compaction ran). Worker-thread staging failures are
-        recorded into `prefetch_errs` (the morsel restages synchronously —
-        a silent degradation otherwise, ADVICE r5). Returns
-        (morsels, re_records) or None when the branch is not
-        device-runnable."""
+        is the lever SF100 q3 was missing). Member partial programs read
+        zero-copy views of the staged union buffer; a group within the
+        fusion budget runs as ONE multi-output program per morsel (one
+        dispatch RTT for all members, streaming.fuse_group + multi-plan
+        CompiledQuery), larger groups run per-member programs over the
+        same buffer. `sinks[i]` is (job, partials_list) for member i:
+        per-morsel partial arrow tables append there, compacting IN the
+        loop whenever a job's accumulated rows outgrow stream_compact_rows
+        (q4-class customer-grained groups at SF100 would otherwise peak
+        host memory before any compaction ran). Worker-thread staging
+        failures are recorded into `prefetch_errs` (the morsel restages
+        synchronously — a silent degradation otherwise, ADVICE r5).
+        Returns (morsels, re_records, bytes_uploaded) or None when some
+        member is not device-runnable."""
         import threading
 
         from . import streaming
         from .jax_backend import to_host
-        from .jax_backend.device import (bucket, free_dtable, pack_table,
-                                         to_device)
+        from .jax_backend.device import (bucket, device_bytes, free_dtable,
+                                         pack_table, to_device)
         from .jax_backend.executor import CompiledQuery, ReplayMismatch
 
         morsel_rows = self.config.chunk_rows
         cap = bucket(morsel_rows)
         jexec, current = shared["jexec"], shared["current"]
-        morsels = self.iter_morsels(branch.big_table, branch.big_columns,
-                                    morsel_rows)
+        mkey = group.morsel_key
+        morsels = self.iter_morsels(group.table, group.columns, morsel_rows)
+        fuse_max = self.config.stream_fusion_max_branches
+        fuse = len(group.plans) > 1 and \
+            (fuse_max <= 0 or len(group.plans) <= fuse_max)
         re_records = 0
         count = 0
+        bytes_uploaded = 0
 
         def record_first(morsel) -> bool:
             current["table"] = morsel
-            _out0, decisions, scan_keys = jexec.record_plan(
-                branch.partial_plan)
-            if jexec.fallback_nodes:
-                return False
-            decisions = streaming.inflate_schedule(decisions, morsel_rows)
-            state["cq"] = CompiledQuery(
-                branch.partial_plan, decisions, scan_keys, mesh=jexec._mesh,
-                shard_min_rows=jexec._shard_min_rows)
-            state["ent"] = {"scan_keys": scan_keys}
-            state["mkey"] = next(
-                k for k in scan_keys
-                if k.startswith(streaming.MORSEL_TABLE + "//"))
+            jexec.fallback_nodes = []
+            if fuse:
+                _outs, decisions, scan_keys = jexec.record_plans(group.plans)
+                if jexec.fallback_nodes:
+                    return False
+                decisions = streaming.inflate_schedule(decisions,
+                                                       morsel_rows)
+                state["cqs"] = [CompiledQuery(
+                    list(group.plans), decisions, scan_keys,
+                    mesh=jexec._mesh,
+                    shard_min_rows=jexec._shard_min_rows)]
+                state["ents"] = [{"scan_keys": scan_keys}]
+            else:
+                # fusion over budget (or single member): per-member
+                # programs, each with its own schedule, all resolving the
+                # shared staged buffer through the same morsel scan key
+                cqs, ents = [], []
+                for p in group.plans:
+                    _out, decisions, scan_keys = jexec.record_plan(p)
+                    if jexec.fallback_nodes:
+                        return False
+                    decisions = streaming.inflate_schedule(decisions,
+                                                           morsel_rows)
+                    cqs.append(CompiledQuery(
+                        p, decisions, scan_keys, mesh=jexec._mesh,
+                        shard_min_rows=jexec._shard_min_rows))
+                    ents.append({"scan_keys": scan_keys})
+                state["cqs"], state["ents"] = cqs, ents
+            state["fused"] = fuse
             return True
 
         def stage(morsel):
-            """Pack + upload one morsel into a fresh device buffer."""
-            cols = state["mkey"].split("//", 1)[1].split(",")
-            packed = pack_table(morsel.select(cols), capacity=cap)
+            """Pack + upload one union-column morsel into a fresh buffer."""
+            sub = morsel.select(group.columns)
+            packed = pack_table(sub, capacity=cap)
             return packed if packed is not None else \
-                to_device(morsel.select(cols), capacity=cap)
+                to_device(sub, capacity=cap)
+
+        def run_members():
+            """Every member program against the staged buffer: one fused
+            dispatch, or per-member dispatches. Returns member outputs in
+            group.plans order."""
+            nonlocal re_records
+            try:
+                if state["fused"]:
+                    return list(state["cqs"][0].run(
+                        jexec._scans_for(state["ents"][0])))
+                return [cq.run(jexec._scans_for(ent))
+                        for cq, ent in zip(state["cqs"], state["ents"])]
+            except ReplayMismatch:
+                # a morsel genuinely exceeded the inflated schedule: run
+                # it eagerly after evicting stale record-side buffers
+                free_dtable(jexec._scan_cache_rec.pop(mkey, None))
+                re_records += 1
+                if state["fused"]:
+                    outs, _, _ = jexec.record_plans(group.plans)
+                    return outs
+                return [jexec.record_plan(p)[0] for p in group.plans]
 
         staged = {}
         stage_thread = None
@@ -546,9 +645,8 @@ class Session:
             it = iter(morsels)
             morsel = next(it, None)
             while morsel is not None:
-                if state["cq"] is None and not record_first(morsel):
+                if state["cqs"] is None and not record_first(morsel):
                     return None
-                mkey = state["mkey"]
                 if "buf" in staged:
                     buf = staged.pop("buf")
                 else:
@@ -567,24 +665,18 @@ class Session:
                             staged["err"] = e
                     stage_thread = threading.Thread(target=work, daemon=True)
                     stage_thread.start()
+                bytes_uploaded += device_bytes(buf)
                 prev = jexec._scan_cache.get(mkey)
                 jexec._scan_cache[mkey] = buf
                 current["table"] = morsel
-                try:
-                    out = state["cq"].run(jexec._scans_for(state["ent"]))
-                except ReplayMismatch:
-                    # a morsel genuinely exceeded the inflated schedule: run
-                    # it eagerly after evicting stale record-side buffers
-                    free_dtable(jexec._scan_cache_rec.pop(mkey, None))
-                    out, _, _ = jexec.record_plan(branch.partial_plan)
-                    re_records += 1
+                outs = run_members()
                 free_dtable(prev)
-                t = arrow_bridge.to_arrow(to_host(out))
-                partials.append(t)
+                for (job, plist), out in zip(sinks, outs):
+                    plist.append(arrow_bridge.to_arrow(to_host(out)))
+                    if sum(p.num_rows for p in plist) > \
+                            self.config.stream_compact_rows:
+                        plist[:] = [self._combine_partials(job, plist)]
                 count += 1
-                if sum(p.num_rows for p in partials) > \
-                        self.config.stream_compact_rows:
-                    partials[:] = [self._combine_partials(job, partials)]
                 if stage_thread is not None:
                     stage_thread.join()
                     stage_thread = None
@@ -597,13 +689,12 @@ class Session:
             if stage_thread is not None:
                 stage_thread.join()
             free_dtable(staged.pop("buf", None))
-            if state["mkey"] is not None:
-                free_dtable(jexec._scan_cache.pop(state["mkey"], None))
-                free_dtable(jexec._scan_cache_rec.pop(state["mkey"], None))
+            free_dtable(jexec._scan_cache.pop(mkey, None))
+            free_dtable(jexec._scan_cache_rec.pop(mkey, None))
             current.pop("table", None)
         if count == 0:
             return None   # empty source: the in-core path handles it
-        return count, re_records
+        return count, re_records, bytes_uploaded
 
     def sql_arrow(self, query: str) -> pa.Table:
         return arrow_bridge.to_arrow(self.sql(query))

@@ -456,6 +456,103 @@ def _try_job(agg: AggregateNode, est_rows, threshold: int
                      _combine_builder(agg, recipes, p_names, p_dtypes))
 
 
+# ---------------------------------------------------------------------------
+# shared-scan morsel fusion (round 7): all streaming branches of one query
+# that scan the same big table share ONE morsel pass. The union of their
+# pruned column sets is packed/uploaded once per morsel; each branch's
+# partial program reads its subset as zero-copy views (a ProjectNode of
+# BCol references over the shared staged buffer — column selection fuses
+# into the compiled program, no copies). q9-class plans carry 15 scalar-
+# subquery jobs over store_sales: without sharing, the dominant scan +
+# upload cost is paid 15 times per query (PERF.md r5 headroom #3; the
+# Flare/shared-scan lineage, ISSUE round 7).
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class ScanGroup:
+    """The streaming branches of one query that scan the same big table.
+
+    `plans[i]` is members[i]'s partial plan rewritten (fuse_group) to read
+    the shared union-column morsel scan; `members[i]` is the (job_index,
+    branch_index) it serves. One morsel iterator + one staged upload per
+    morsel serves every member."""
+    table: str
+    columns: list[str]             # union of member pruned column sets
+    dtypes: list[str]
+    members: list[tuple]           # (job_index, branch_index)
+    plans: list[PlanNode]
+
+    @property
+    def morsel_key(self) -> str:
+        """The executor scan-cache key every member's program reads."""
+        return MORSEL_TABLE + "//" + ",".join(self.columns)
+
+
+def _morsel_scan(plan: PlanNode) -> ScanNode:
+    return next(n for n in P.iter_plan_nodes(plan)
+                if isinstance(n, ScanNode) and n.table == MORSEL_TABLE)
+
+
+def fuse_group(branches: list[BranchStream]
+               ) -> tuple[list[str], list[str], list[PlanNode]]:
+    """Union the branches' pruned big-scan column sets and rewrite each
+    partial plan so its morsel scan reads the UNION with a projection back
+    to the branch's subset: every member then resolves against one staged
+    device buffer per morsel (one pack + one upload), and the projection is
+    zero-copy column selection inside the traced program. A branch already
+    reading exactly the union keeps its plan unchanged (the single-branch /
+    shared_scan=off case degenerates to the old per-branch behavior)."""
+    union: list[str] = []
+    dty: dict[str, str] = {}
+    scans = []
+    for b in branches:
+        scan = _morsel_scan(b.partial_plan)
+        scans.append(scan)
+        for c, d in zip(scan.columns, scan.out_dtypes):
+            if c not in dty:
+                union.append(c)
+                dty[c] = d
+    dtypes = [dty[c] for c in union]
+    idx = {c: i for i, c in enumerate(union)}
+    plans = []
+    for b, scan in zip(branches, scans):
+        if list(scan.columns) == union:
+            plans.append(b.partial_plan)
+            continue
+        shared = ScanNode(table=MORSEL_TABLE, columns=list(union),
+                          out_names=list(union), out_dtypes=list(dtypes))
+        view = P.column_view(shared, [idx[c] for c in scan.columns],
+                             list(scan.out_names), list(scan.out_dtypes))
+        plans.append(substitute_nodes(b.partial_plan, {id(scan): view}))
+    return union, dtypes, plans
+
+
+def plan_scan_groups(jobs: list[StreamJob], shared: bool) -> list[ScanGroup]:
+    """Partition every streaming branch of `jobs` into ScanGroups: by big
+    table when `shared` (one morsel pass per table per query), one group
+    per branch otherwise (the pre-round-7 behavior, kept reachable for A/B
+    via shared_scan=False / --no_shared_scan). Branch order inside a group
+    is (job, branch) order, so partial-merge order is deterministic."""
+    keyed: dict = {}
+    order: list = []
+    for ji, job in enumerate(jobs):
+        for bi, b in enumerate(job.branches):
+            if b.big_table is None:
+                continue
+            key = b.big_table if shared else (ji, bi)
+            if key not in keyed:
+                keyed[key] = []
+                order.append(key)
+            keyed[key].append((ji, bi, b))
+    groups = []
+    for key in order:
+        members = keyed[key]
+        cols, dtypes, plans = fuse_group([b for _, _, b in members])
+        groups.append(ScanGroup(members[0][2].big_table, cols, dtypes,
+                                [(ji, bi) for ji, bi, _ in members], plans))
+    return groups
+
+
 def _expr_subplans(node: PlanNode):
     """Plans embedded in this node's EXPRESSIONS (BScalarSubquery) —
     q9-class scalar-subquery aggregates over big scans live there."""

@@ -164,7 +164,8 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
                      query_timeout: float | None = None,
                      query_attempts: int | None = None,
                      resume: bool = False,
-                     late_mat: bool | None = None
+                     late_mat: bool | None = None,
+                     shared_scan: bool | None = None
                      ) -> list[tuple[str, int, int, int]]:
     """Run every query in the stream; returns (name, start_ms, end_ms, ms).
 
@@ -206,6 +207,8 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
     apply_decimal(config, decimal)
     if late_mat is not None:     # --no_late_mat A/B override
         config.late_materialization = late_mat
+    if shared_scan is not None:  # --no_shared_scan A/B override
+        config.shared_scan = shared_scan
     session = Session(config)
     setup_tables(session, input_prefix, input_format)
 
@@ -441,6 +444,12 @@ def main(argv: list[str] | None = None) -> int:
                         "(group by surrogate keys, gather dimension "
                         "attributes after aggregation) for A/B runs; "
                         "property: nds.tpu.late_materialization")
+    p.add_argument("--no_shared_scan", action="store_true",
+                   help="disable shared-scan morsel fusion (one streaming "
+                        "pass per big table per query serving every "
+                        "branch) for A/B runs — each branch then streams "
+                        "its table separately, the pre-round-7 behavior; "
+                        "property: nds.tpu.shared_scan")
     a = p.parse_args(argv)
     sub = a.sub_queries.split(",") if a.sub_queries else None
     inject = a.fault_inject.split(",") if a.fault_inject else None
@@ -452,7 +461,8 @@ def main(argv: list[str] | None = None) -> int:
                      decimal=a.decimal, precompile=not a.no_precompile,
                      query_timeout=a.query_timeout, query_attempts=a.retry,
                      resume=a.resume,
-                     late_mat=False if a.no_late_mat else None)
+                     late_mat=False if a.no_late_mat else None,
+                     shared_scan=False if a.no_shared_scan else None)
     return 0
 
 

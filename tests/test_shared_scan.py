@@ -1,0 +1,334 @@
+"""Shared-scan morsel fusion (streaming.plan_scan_groups / fuse_group +
+session._stream_group): all streaming branches of one query that scan the
+same big table share ONE morsel pass — the union of their pruned column
+sets uploads once per morsel, each branch reads zero-copy views of the
+staged buffer, and groups within the fusion budget run as a single
+multi-output program per morsel.
+
+Exactness is pinned three ways: against an independent SQLite oracle over
+the same rows, against the engine's numpy oracle, and BIT-IDENTICAL across
+the three streaming modes (shared+fused / shared-unfused / per-branch —
+the --no_shared_scan A/B contract). The scan-pass economics are pinned by
+last_exec_stats: q9-class queries stream each big table exactly once per
+execution."""
+import math
+import os
+import sqlite3
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from nds_tpu.config import EngineConfig
+from nds_tpu.engine import Session
+from nds_tpu.engine import streaming
+
+N_FACT, N_DIM = 50_000, 300
+CHUNK = 4_096
+PER_PASS = -(-N_FACT // CHUNK)          # morsels in one full fact pass
+
+
+@pytest.fixture(scope="module")
+def data(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("shared_scan")
+    rng = np.random.default_rng(11)
+    qty = rng.integers(1, 50, N_FACT).astype(object)
+    qty[rng.random(N_FACT) < 0.05] = None      # NULLs exercise sum_guarded
+    fact = pa.table({
+        "fk": pa.array(rng.integers(0, N_DIM + 9, N_FACT), type=pa.int32()),
+        "qty": pa.array(list(qty), type=pa.int32()),
+        "price": pa.array(np.round(rng.uniform(1, 100, N_FACT), 2)),
+        "day": pa.array(rng.integers(0, 365, N_FACT), type=pa.int32()),
+    })
+    path = os.path.join(str(tmp), "fact.parquet")
+    pq.write_table(fact, path, row_group_size=8192)
+    dim = pa.table({"dk": pa.array(np.arange(N_DIM), type=pa.int32()),
+                    "grp": pa.array((np.arange(N_DIM) % 13)
+                                    .astype(np.int32))})
+    return {"fact_path": path, "fact": fact, "dim": dim}
+
+
+def make_session(data, shared_scan=True, fuse_max=16, chunk=CHUNK):
+    cfg = EngineConfig(out_of_core=True, chunk_rows=chunk,
+                       out_of_core_min_rows=10_000,
+                       shared_scan=shared_scan,
+                       stream_fusion_max_branches=fuse_max)
+    s = Session(cfg)
+    s.register_parquet("fact", data["fact_path"])
+    s.register_arrow("dim", data["dim"])
+    return s
+
+
+def sqlite_conn(data):
+    conn = sqlite3.connect(":memory:")
+    for name, t in (("fact", data["fact"]), ("dim", data["dim"])):
+        cols = ", ".join(f'"{c}"' for c in t.column_names)
+        conn.execute(f"CREATE TABLE {name} ({cols})")
+        rows = list(zip(*[t.column(c).to_pylist() for c in t.column_names]))
+        conn.executemany(
+            f"INSERT INTO {name} VALUES "
+            f"({','.join('?' * len(t.column_names))})", rows)
+    conn.commit()
+    return conn
+
+
+def rows_of(t):
+    return [tuple(r) for r in t.to_pylist()]
+
+
+def rows_close(got, want, rel=1e-5):
+    """Row-wise equality with float tolerance (the device accumulates
+    f32 without x64; sum order also differs from the oracles')."""
+    if len(got) != len(want):
+        return False
+    for g, w in zip(got, want):
+        if len(g) != len(w):
+            return False
+        for a, b in zip(g, w):
+            if isinstance(a, float) or isinstance(b, float):
+                if (a is None) != (b is None):
+                    return False
+                if a is not None and not math.isclose(
+                        float(a), float(b), rel_tol=rel, abs_tol=1e-8):
+                    return False
+            elif a != b:
+                return False
+    return True
+
+
+# q9-class: a battery of scalar-subquery aggregates over the big table,
+# each pruning a DIFFERENT column subset (the union exercises fuse_group)
+Q9 = """
+SELECT d.grp,
+       CASE WHEN (SELECT COUNT(*) FROM fact WHERE day < 100) > 10
+            THEN (SELECT AVG(price) FROM fact WHERE day < 100)
+            ELSE (SELECT AVG(qty) FROM fact WHERE day >= 100) END AS v,
+       (SELECT SUM(qty) FROM fact WHERE day >= 200) AS s
+FROM dim d WHERE d.dk < 3
+"""
+Q9_JOBS = 4
+
+# q2/q5-class: two aggregate jobs, each a UNION ALL over the same two fact
+# channels — per (job, channel) branches collapse to one pass per channel
+UNION2 = """
+SELECT a.grp, a.total, b.total
+FROM (SELECT d.grp AS grp, SUM(u.amt) AS total
+      FROM (SELECT fk, amt FROM ch_a UNION ALL SELECT fk, amt FROM ch_b) u
+      JOIN dim d ON u.fk = d.dk WHERE u.amt < 400 GROUP BY d.grp) a
+JOIN (SELECT d.grp AS grp, SUM(u.amt) AS total
+      FROM (SELECT fk, amt FROM ch_a UNION ALL SELECT fk, amt FROM ch_b) u
+      JOIN dim d ON u.fk = d.dk WHERE u.amt >= 400 GROUP BY d.grp) b
+ON a.grp = b.grp ORDER BY a.grp
+"""
+
+# q10-class: a semi-join build side AND a scalar subquery over one table
+SEMI = """
+SELECT d.grp, COUNT(*) AS cnt FROM dim d
+WHERE EXISTS (SELECT 1 FROM fact f WHERE f.fk = d.dk AND f.day < 50)
+  AND d.dk < (SELECT AVG(fk) FROM fact) + 100
+GROUP BY d.grp ORDER BY d.grp
+"""
+
+
+def run_modes(data, q):
+    """The three streaming modes; returns (rows per mode, stats per mode)."""
+    out, stats = [], []
+    for shared, fuse_max in ((True, 16), (True, 1), (False, 16)):
+        s = make_session(data, shared_scan=shared, fuse_max=fuse_max)
+        got = rows_of(s.sql(q, backend="jax"))
+        assert s.last_exec_stats["mode"] == "streaming"
+        out.append(got)
+        stats.append(dict(s.last_exec_stats))
+    return out, stats
+
+
+def test_q9_single_pass_pinned(data):
+    """Acceptance: a q9-class query streams the big table EXACTLY once —
+    one scan pass, one full-pass morsel count — while serving every job."""
+    s = make_session(data)
+    s.sql(Q9, backend="jax")
+    st = s.last_exec_stats
+    assert st["mode"] == "streaming"
+    assert st["jobs"] == Q9_JOBS
+    assert st["scan_passes"] == 1
+    assert st["tables_streamed"] == 1
+    assert st["branches_served"] == Q9_JOBS
+    assert st["morsels"] == PER_PASS                 # not jobs * PER_PASS
+    assert st["morsels_per_table"] == {"fact": PER_PASS}
+    assert st["fused_groups"] == 1
+    assert st["bytes_uploaded"] > 0
+    assert st["re_records"] == 0
+
+
+def test_q9_differential_sqlite_and_modes(data):
+    """Fused, shared-unfused, and per-branch must be BIT-IDENTICAL to each
+    other and match the SQLite + numpy oracles within float tolerance."""
+    (fused, unfused, perbranch), (st_f, st_u, st_p) = run_modes(data, Q9)
+    assert fused == unfused == perbranch
+    assert st_f["scan_passes"] == 1 and st_u["scan_passes"] == 1
+    assert st_u["fused_groups"] == 0                 # budget=1 opted out
+    assert st_p["scan_passes"] == Q9_JOBS            # old per-branch passes
+    assert st_p["morsels"] == Q9_JOBS * PER_PASS
+    want = sqlite_conn(data).execute(Q9).fetchall()
+    assert rows_close(fused, want), (fused[:3], want[:3])
+    s = make_session(data)
+    oracle = rows_of(s.sql(Q9, backend="numpy"))
+    assert rows_close(fused, oracle)
+
+
+def test_union_channels_share_per_table_pass(data):
+    """Two union-channel jobs over the same two fact tables: shared scan
+    collapses 4 streamed branches into one pass per channel table."""
+    rng = np.random.default_rng(9)
+    tmp = os.path.dirname(data["fact_path"])
+    chans = {}
+    for name, n in (("ch_a", 30_000), ("ch_b", 25_000)):
+        t = pa.table({
+            "fk": pa.array(rng.integers(0, N_DIM, n), type=pa.int32()),
+            "amt": pa.array(rng.integers(1, 500, n), type=pa.int64()),
+        })
+        path = os.path.join(tmp, f"{name}.parquet")
+        pq.write_table(t, path, row_group_size=8192)
+        chans[name] = (t, path)
+    results, stats = [], []
+    for shared in (True, False):
+        s = make_session(data, shared_scan=shared)
+        for name, (_t, path) in chans.items():
+            s.register_parquet(name, path)
+        results.append(rows_of(s.sql(UNION2, backend="jax")))
+        stats.append(dict(s.last_exec_stats))
+    st_shared, st_per = stats
+    assert results[0] == results[1]
+    assert st_shared["mode"] == st_per["mode"] == "streaming"
+    assert st_shared["jobs"] == 2
+    assert st_shared["branches_served"] == 4         # 2 jobs x 2 channels
+    assert st_shared["scan_passes"] == 2             # one per channel table
+    assert st_shared["tables_streamed"] == 2
+    per_pass = -(-30_000 // CHUNK) + -(-25_000 // CHUNK)
+    assert st_shared["morsels"] == per_pass
+    assert st_per["scan_passes"] == 4
+    assert st_per["morsels"] == 2 * per_pass
+    # independent oracle
+    conn = sqlite3.connect(":memory:")
+    for name, t in (("dim", data["dim"]), ("ch_a", chans["ch_a"][0]),
+                    ("ch_b", chans["ch_b"][0])):
+        cols = ", ".join(f'"{c}"' for c in t.column_names)
+        conn.execute(f"CREATE TABLE {name} ({cols})")
+        conn.executemany(
+            f"INSERT INTO {name} VALUES "
+            f"({','.join('?' * len(t.column_names))})",
+            list(zip(*[t.column(c).to_pylist() for c in t.column_names])))
+    want = conn.execute(UNION2).fetchall()
+    assert rows_close(results[0], want)
+
+
+def test_semi_join_build_side_shares_pass(data):
+    """q10-class: the semi-join distinct-key job and a scalar-subquery job
+    both scan the big table — one shared pass serves both."""
+    (fused, unfused, perbranch), (st_f, _su, st_p) = run_modes(data, SEMI)
+    assert fused == unfused == perbranch
+    assert st_f["jobs"] == 2
+    assert st_f["scan_passes"] == 1
+    assert st_f["branches_served"] == 2
+    assert st_f["morsels"] == PER_PASS
+    assert st_p["morsels"] == 2 * PER_PASS
+    want = sqlite_conn(data).execute(SEMI).fetchall()
+    assert rows_close(fused, want)
+
+
+def test_fuse_group_unions_columns(data):
+    """Plan-level: one group per big table, union column set, and each
+    member plan reading its subset through the shared morsel scan."""
+    import nds_tpu.engine.plan as P
+    from nds_tpu.engine.planner import Planner
+    from nds_tpu.sql import parse_sql
+
+    s = make_session(data)
+    plan = Planner(s._catalog()).plan_query(parse_sql(Q9))
+    jobs = streaming.find_streaming_jobs(
+        plan, lambda t: s._est_rows.get(t, 0),
+        s.config.out_of_core_min_rows)
+    assert len(jobs) == Q9_JOBS
+    groups = streaming.plan_scan_groups(jobs, shared=True)
+    assert len(groups) == 1
+    g = groups[0]
+    assert g.table == "fact"
+    want_union = {c for j in jobs for b in j.branches
+                  for c in b.big_columns}
+    assert set(g.columns) == want_union
+    assert {"day", "price", "qty"} <= set(g.columns)
+    assert g.morsel_key == \
+        streaming.MORSEL_TABLE + "//" + ",".join(g.columns)
+    assert len(g.plans) == Q9_JOBS
+    for member_plan in g.plans:
+        scans = [n for n in P.iter_plan_nodes(member_plan)
+                 if isinstance(n, P.ScanNode)
+                 and n.table == streaming.MORSEL_TABLE]
+        assert len(scans) == 1
+        assert list(scans[0].columns) == list(g.columns)
+    # per-branch grouping (shared=False) keeps each branch's own columns
+    per = streaming.plan_scan_groups(jobs, shared=False)
+    assert len(per) == Q9_JOBS
+    assert all(len(p.members) == 1 for p in per)
+
+
+def test_upload_volume_shared_below_per_branch(data):
+    """The union upload must cost less than the per-branch uploads it
+    replaces (the whole point of the shared scan)."""
+    s = make_session(data, shared_scan=True)
+    s.sql(Q9, backend="jax")
+    shared_bytes = s.last_exec_stats["bytes_uploaded"]
+    s2 = make_session(data, shared_scan=False)
+    s2.sql(Q9, backend="jax")
+    per_branch_bytes = s2.last_exec_stats["bytes_uploaded"]
+    assert 0 < shared_bytes < per_branch_bytes
+
+
+def test_live_config_toggle_invalidates_stream_cache(data):
+    """Satellite: _stream_cache keys on a config fingerprint — toggling
+    shared_scan / chunk_rows / late_materialization on a LIVE session must
+    not replay stale groups, programs, or not-streamable sentinels."""
+    s = make_session(data)
+    a = rows_of(s.sql(Q9, backend="jax"))
+    assert s.last_exec_stats["scan_passes"] == 1
+    s.config.shared_scan = False
+    b = rows_of(s.sql(Q9, backend="jax"))
+    assert s.last_exec_stats["scan_passes"] == Q9_JOBS
+    assert a == b
+    s.config.shared_scan = True
+    s.config.chunk_rows = CHUNK * 2
+    c = rows_of(s.sql(Q9, backend="jax"))
+    assert s.last_exec_stats["morsels"] == -(-N_FACT // (CHUNK * 2))
+    assert a == c
+    # a threshold flip must drop the "streams" entry (and vice versa): the
+    # sentinel for this query may not survive the config change
+    s.config.out_of_core_min_rows = N_FACT * 10
+    s.sql(Q9, backend="jax")
+    assert s.last_exec_stats.get("mode") != "streaming"
+    s.config.out_of_core_min_rows = 10_000
+    s.sql(Q9, backend="jax")
+    assert s.last_exec_stats["mode"] == "streaming"
+
+
+def test_iter_morsels_single_slice_zero_copy(data, monkeypatch):
+    """Satellite: a morsel assembled from ONE pending slice must pass
+    through without pa.concat_tables (the aligned-batch common case)."""
+    calls = {"n": 0}
+    real = pa.concat_tables
+
+    def counting(tables, *a, **k):
+        calls["n"] += 1
+        return real(tables, *a, **k)
+
+    s = make_session(data)
+    monkeypatch.setattr(pa, "concat_tables", counting)
+    # parquet row groups are 8192 = 2 * CHUNK: every morsel is one slice
+    morsels = list(s.iter_morsels("fact", ["fk", "day"], CHUNK))
+    assert calls["n"] == 0
+    assert sum(m.num_rows for m in morsels) == N_FACT
+    assert max(m.num_rows for m in morsels) <= CHUNK
+    # misaligned chunking still re-chunks correctly (concat engaged)
+    morsels = list(s.iter_morsels("fact", ["fk"], 5_000))
+    assert calls["n"] > 0
+    assert sum(m.num_rows for m in morsels) == N_FACT

@@ -5,6 +5,11 @@
 # Stages:
 #   native     - build the C++ data generator and self-check one tiny table
 #   resilience - fast smoke of the fault-injection/retry/deadline layer
+#   static     - static analysis BEFORE anything executes: the engine-
+#                discipline lint (scripts/lint_engine.py — frozen plan IR,
+#                locked cross-thread writes) and the plan-IR verifier sweep
+#                (every bundled template through per-pass verification +
+#                seeded-corruption mutation tests, tests/test_plan_verify.py)
 #   planner    - planner/streaming tier-1: late-materialization legality/
 #                differential, capacity-ladder, and shared-scan morsel
 #                fusion tests (fast, CPU backend): these rewrites change
@@ -20,6 +25,10 @@ REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}"
 export NDS_TPU_JIT_PLANS=1
+# CI default: verify the fully rewritten plan of every planned statement
+# (engine/verify.py). Bench runs measure with verification off; the static
+# stage exercises the stricter per-pass mode through the template sweep.
+export NDS_TPU_VERIFY_PLANS="${NDS_TPU_VERIFY_PLANS:-final}"
 
 stage_native() {
     make -C "$REPO/native/datagen"
@@ -43,6 +52,14 @@ stage_resilience() {
     (cd "$REPO" && python -m pytest tests/test_resilience.py -q)
 }
 
+stage_static() {
+    # catch rewrite bugs before they execute: lint the engine source, then
+    # sweep every bundled query template through per-pass plan verification
+    (cd "$REPO" && python scripts/lint_engine.py nds_tpu)
+    (cd "$REPO" && python -m pytest tests/test_plan_verify.py \
+        tests/test_lint_engine.py -q)
+}
+
 stage_planner() {
     (cd "$REPO" && python -m pytest tests/test_late_materialization.py \
         tests/test_capacity_ladder.py tests/test_shared_scan.py \
@@ -56,7 +73,9 @@ stage_test() {
 stage_bench() {
     local d
     d="$(mktemp -d)"
+    # bench measures raw engine time: plan verification off
     (cd "$REPO" && NDS_TPU_BENCH_DIR="$d" NDS_TPU_BENCH_SF=0.01 \
+        NDS_TPU_VERIFY_PLANS=off \
         NDS_TPU_BENCH_QUERIES=query3,query7 python bench.py)
     rm -rf "$d"
 }
@@ -64,12 +83,13 @@ stage_bench() {
 case "${1:-all}" in
     native)     stage_native ;;
     resilience) stage_resilience ;;
+    static)     stage_static ;;
     planner)    stage_planner ;;
     test)       stage_test ;;
     bench)      stage_bench ;;
-    all)        stage_native; stage_resilience; stage_planner; stage_test
-                stage_bench ;;
-    --list)     echo "native resilience planner test bench all" ;;
-    *) echo "usage: run_ci.sh [native|resilience|planner|test|bench|all|--list]" >&2
+    all)        stage_native; stage_resilience; stage_static; stage_planner
+                stage_test; stage_bench ;;
+    --list)     echo "native resilience static planner test bench all" ;;
+    *) echo "usage: run_ci.sh [native|resilience|static|planner|test|bench|all|--list]" >&2
        exit 2 ;;
 esac

@@ -72,6 +72,17 @@ class EngineConfig:
     # this big (small plans gain nothing and pay an extra small join + merge
     # aggregate). 0 fires unconditionally.
     late_mat_min_rows: int = 1 << 20
+    # static plan-IR verification between planner rewrite passes
+    # (engine/verify.py via planner.PassPipeline):
+    #   "off"      — zero verification cost (bench/production default)
+    #   "final"    — verify the fully rewritten plan once per statement
+    #   "per-pass" — verify between every rewrite pass, with shared-node
+    #                freeze checks and pass attribution (PlanVerifyError
+    #                names the node and the pass that introduced it)
+    # Property: nds.tpu.verify_plans; NDS_TPU_VERIFY_PLANS sets the default
+    # (CI exports "final"; bench runs keep "off").
+    verify_plans: str = field(default_factory=lambda: os.environ.get(
+        "NDS_TPU_VERIFY_PLANS", "off"))
     # run jitted per-op kernels (True) or pure-numpy fallback (False, debug only)
     use_jax: bool = True
     # compile whole plans to one XLA program on re-execution (record/replay);

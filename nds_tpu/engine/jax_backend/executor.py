@@ -54,6 +54,15 @@ class ReplayMismatch(Exception):
     """A compiled plan's capacity schedule no longer fits the data."""
 
 
+class ArgSpecMismatch(ValueError):
+    """Concrete arguments do not fit a compiled program's input contract.
+
+    Raised with a PER-ARGUMENT expected-vs-got dtype/shape report (scan
+    keys and parameter slots named) instead of the bare structural mismatch
+    the JAX call site would produce — argument drift is the hardest
+    compiled-replay failure to localize otherwise."""
+
+
 _NOJIT_ERRORS = (NotJittable, NotImplementedError,
                  jax.errors.TracerArrayConversionError,
                  jax.errors.ConcretizationTypeError)
@@ -129,6 +138,7 @@ class CompiledQuery:
         self._fn = None
         self._aot = None     # AOT executable from precompile()
         self._aot_specs = None  # flat (shape, dtype) list the AOT was lowered for
+        self._aot_arg_specs = None  # per-argument [(label, specs)] for reports
         # _SHARED_PROGRAMS hands one CompiledQuery to every stream of a
         # template: concurrent multi-stream runs must not race the lazy
         # _fn/_aot initialization (ADVICE r5)
@@ -162,6 +172,20 @@ class CompiledQuery:
         return out, rec.checks
 
     def _args(self, scans: dict, values: tuple) -> tuple:
+        missing = [k for k in self.scan_keys if k not in scans]
+        if missing:
+            raise ArgSpecMismatch(
+                f"missing scan argument(s) {missing} "
+                f"(program takes {len(self.scan_keys)} scan(s): "
+                f"{list(self.scan_keys)})")
+        if len(values) != len(self.param_dtypes):
+            # zip would silently truncate: a short parameter vector would
+            # execute with the wrong literals, not fail
+            raise ArgSpecMismatch(
+                f"parameter vector length mismatch: program expects "
+                f"{len(self.param_dtypes)} hoisted parameter(s) with "
+                f"dtypes {list(self.param_dtypes)}, got "
+                f"{len(values)} value(s)")
         scan_tuple = tuple(scans[k] for k in self.scan_keys)
         params = tuple(jnp.asarray(v, dtype=phys_dtype(d))
                        for v, d in zip(values, self.param_dtypes))
@@ -190,6 +214,7 @@ class CompiledQuery:
         with self._lock:
             self._aot = aot
             self._aot_specs = self._flat_specs((scan_specs, params))
+            self._aot_arg_specs = self._arg_spec_table(scan_specs, params)
         if stats is not None:
             stats["precompile_s"] = round(_time.perf_counter() - t0, 3)
 
@@ -216,6 +241,58 @@ class CompiledQuery:
         got = self._flat_specs(args)
         return got is not None and got == self._aot_specs
 
+    def _arg_spec_table(self, scan_tuple, params) -> list:
+        """[(argument label, flat specs)] with one entry per program
+        argument: scan tables by their cache key, parameter slots by index
+        and engine dtype — the unit of the expected-vs-got report."""
+        table = []
+        for k, s in zip(self.scan_keys, scan_tuple):
+            table.append((f"scan {k!r}", self._flat_specs(s)))
+        for i, (p, d) in enumerate(zip(params, self.param_dtypes)):
+            table.append((f"param {i} ({d})", self._flat_specs((p,))))
+        return table
+
+    @staticmethod
+    def _fmt_spec(spec) -> str:
+        shape, dtype = spec
+        return f"{dtype}[{','.join(map(str, shape))}]"
+
+    def spec_mismatch_report(self, scans: dict, values: tuple = ()
+                             ) -> Optional[str]:
+        """Per-argument expected-vs-got dtype/shape report against the
+        precompiled input specs; None when everything fits (or no AOT
+        specs exist to validate against)."""
+        if self._aot_arg_specs is None:
+            return None
+        scan_tuple, params = self._args(scans, values)
+        got_table = self._arg_spec_table(scan_tuple, params)
+        lines: list[str] = []
+        for (label, exp), (_, got) in zip(self._aot_arg_specs, got_table):
+            if exp == got:
+                continue
+            if exp is None or got is None:
+                lines.append(f"{label}: argument is not inspectable")
+                continue
+            if len(exp) != len(got):
+                lines.append(f"{label}: expected {len(exp)} array(s) "
+                             f"(e.g. columns/validity), got {len(got)}")
+                continue
+            for j, (e, g) in enumerate(zip(exp, got)):
+                if e != g:
+                    lines.append(
+                        f"{label} leaf {j}: expected "
+                        f"{self._fmt_spec(e)}, got {self._fmt_spec(g)}")
+        return "\n".join(lines) or None
+
+    def validate_args(self, scans: dict, values: tuple = ()) -> None:
+        """Raise ArgSpecMismatch naming every drifted argument (expected vs
+        got dtype/shape) when the concrete args do not fit the compiled
+        program; silently returns when they fit or nothing is compiled."""
+        report = self.spec_mismatch_report(scans, values)
+        if report:
+            raise ArgSpecMismatch(
+                "compiled program argument mismatch:\n" + report)
+
     def run(self, scans: dict, values: tuple = (),
             stats: Optional[dict] = None,
             keep_device: bool = False) -> DTable:
@@ -235,7 +312,13 @@ class CompiledQuery:
             # shape/dtype drift against the precompiled specs: take the jit
             # path explicitly (the persistent compile cache still serves the
             # binary when the lowering matches) instead of letting the AOT
-            # call fail and masking the error class
+            # call fail and masking the error class. The per-argument
+            # expected-vs-got report lands in stats so the drift is
+            # attributable to a specific scan/param, not a bare mismatch.
+            if stats is not None:
+                report = self.spec_mismatch_report(scans, values)
+                if report:
+                    stats["spec_mismatch"] = report
             with self._lock:
                 if self._aot is aot:
                     self._aot = None

@@ -220,17 +220,47 @@ def column_view(child: PlanNode, indices: list[int], out_names: list[str],
 
 
 def walk(node: PlanNode):
-    """Pre-order traversal of a plan tree."""
-    yield node
-    for f in ("child", "left", "right"):
-        sub = getattr(node, f, None)
-        if isinstance(sub, PlanNode):
-            yield from walk(sub)
+    """Pre-order traversal of the child/left/right plan structure, memoized
+    on node identity: a shared subtree (CTE DAG) yields ONCE, so traversal
+    is linear in the number of distinct nodes instead of exponential in the
+    sharing depth (a q14-class WITH clause consumed k times at d nesting
+    levels would otherwise expand k^d visits)."""
+    seen: set[int] = set()
+    stack: list[PlanNode] = [node]
+    while stack:
+        n = stack.pop()
+        if id(n) in seen:
+            continue
+        seen.add(id(n))
+        yield n
+        # push right-to-left so pre-order (child first) is preserved
+        for f in ("right", "left", "child"):
+            sub = getattr(n, f, None)
+            if isinstance(sub, PlanNode):
+                stack.append(sub)
+
+
+_FIELD_CACHE: dict[type, tuple] = {}
+
+
+def type_fields(x) -> tuple:
+    """Dataclass field names of x's type, cached per type (dataclasses.
+    fields() re-resolves per call; plan traversal is hot enough to care)."""
+    import dataclasses as _dc
+
+    t = type(x)
+    names = _FIELD_CACHE.get(t)
+    if names is None:
+        names = tuple(f.name for f in _dc.fields(t))
+        _FIELD_CACHE[t] = names
+    return names
 
 
 def iter_plan_nodes(root: PlanNode):
     """Every distinct PlanNode reachable from `root`, INCLUDING plans embedded
-    in expressions (BScalarSubquery) — shared nodes (CTE DAG) yield once."""
+    in expressions (BScalarSubquery) — shared nodes (CTE DAG) yield once.
+    Traversal memoizes on object identity for EVERY dataclass (plan nodes
+    and expression trees alike), so shared-DAG plans walk in linear time."""
     import dataclasses as _dc
 
     seen: set[int] = set()
@@ -244,11 +274,22 @@ def iter_plan_nodes(root: PlanNode):
             yield x
             if isinstance(x, MaterializedNode):
                 continue      # its Table payload holds no plan nodes
+        elif isinstance(x, (BCol, BLit, BParam)):
+            continue          # leaf expressions hold no plan nodes
         if _dc.is_dataclass(x) and not isinstance(x, type):
-            for f in _dc.fields(x):
-                stack.append(getattr(x, f.name))
+            if not isinstance(x, PlanNode):
+                if id(x) in seen:
+                    continue
+                seen.add(id(x))
+            for name in type_fields(x):
+                v = getattr(x, name)
+                if v is not None and not isinstance(v, (str, int, float,
+                                                        bool)):
+                    stack.append(v)
         elif isinstance(x, (list, tuple)):
-            stack.extend(x)
+            stack.extend(v for v in x
+                         if v is not None and
+                         not isinstance(v, (str, int, float, bool)))
 
 
 # ops whose handlers consume literal arguments as traced device scalars —

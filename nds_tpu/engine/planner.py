@@ -32,6 +32,90 @@ class PlanError(ValueError):
     pass
 
 
+class PassPipeline:
+    """Runs the planner's top-level rewrite passes with machine-checked IR
+    invariants between them (engine/verify.py), under
+    ``EngineConfig.verify_plans``:
+
+    - ``off``: zero verification cost — passes run exactly as before;
+    - ``final``: the fully rewritten plan is verified once per statement
+      (cheap safety net for CI);
+    - ``per-pass``: every pass output is verified, each pass's input is
+      fingerprint-snapshotted so in-place mutation of surviving (shared)
+      nodes is caught (the `_exact_rational_keys` hazard class, ADVICE r5),
+      and a violation raises PlanVerifyError naming the offending node AND
+      the pass that introduced it — the pass whose output first fails.
+
+    Two of the last three rounds shipped fixes for bugs rewrite passes
+    introduced silently; this is the safety net cheaper than a SQLite
+    differential run."""
+
+    def __init__(self, mode: str, catalog: Optional["Catalog"] = None):
+        if mode not in ("off", "final", "per-pass"):
+            raise PlanError(f"unknown verify_plans mode {mode!r} "
+                            "(expected off, final, or per-pass)")
+        self.mode = mode
+        self.catalog = catalog
+        # rolling fingerprint snapshot of the last verified plan (per-pass
+        # mode): each pass's freeze scan doubles as the next pass's
+        # snapshot, so verification pays one fingerprint walk per pass
+        self._snap: Optional[dict] = None
+
+    def _verify(self, plan, pass_name: str, deep: bool = False) -> None:
+        from .verify import PlanVerifyError, node_labels, verify_plan
+        labels = node_labels(plan)
+        findings = verify_plan(plan, self.catalog, deep=deep, labels=labels)
+        if findings:
+            raise PlanVerifyError(findings, pass_name)
+
+    def check(self, pass_name: str, plan):
+        """Verify a pass-less snapshot (the freshly bound plan)."""
+        if self.mode == "per-pass":
+            self._verify(plan, pass_name)
+            from .verify import snapshot
+            self._snap = snapshot(plan)
+        return plan
+
+    def run(self, pass_name: str, fn, plan):
+        """Run one rewrite pass; in per-pass mode, prove surviving nodes
+        are structurally frozen and the output plan verifies clean."""
+        if self.mode != "per-pass":
+            return fn(plan)
+        from .verify import PlanVerifyError, frozen_scan, verify_plan
+        before = self._snap if self._snap is not None else \
+            frozen_scan(plan, None)[1]
+        out = fn(plan)
+        findings, after = frozen_scan(out, before)
+        if findings:
+            raise PlanVerifyError(findings, pass_name)
+        self._snap = after
+        if out is plan:
+            # same root object and zero mutated survivors: the pass output
+            # is byte-identical to its (already verified) input
+            return out
+        findings = verify_plan(out, self.catalog)
+        if findings:
+            raise PlanVerifyError(findings, pass_name)
+        return out
+
+    def finish(self, plan):
+        """Final verification: in ``final`` mode this is the only check; in
+        ``per-pass`` mode the shape checks already ran after every pass, so
+        only the deep checks (parameter-hoisting round-trip) remain — they
+        run once per statement, not per pass."""
+        if self.mode == "off":
+            return plan
+        if self.mode == "final":
+            self._verify(plan, "final", deep=True)
+            return plan
+        from .verify import PlanVerifyError, _fill_labels, check_params
+        findings = check_params(plan)
+        _fill_labels(findings, plan, None)
+        if findings:
+            raise PlanVerifyError(findings, "final")
+        return plan
+
+
 # engine dtype helpers -------------------------------------------------------
 
 _AGG_FUNCS = {"sum", "avg", "min", "max", "count", "stddev_samp", "stddev"}
@@ -86,6 +170,9 @@ class Catalog:
     # late-materialization rewrite toggle + size gate (EngineConfig mirrors)
     late_mat: bool = True
     late_mat_min_rows: int = 1 << 20
+    # static plan-IR verification mode (EngineConfig.verify_plans mirror):
+    # off | final | per-pass — see PassPipeline / engine/verify.py
+    verify_plans: str = "off"
 
     def schema(self, name: str) -> tuple[list[str], list[str]]:
         if name not in self.tables:
@@ -132,38 +219,49 @@ class Planner:
             ctes[name] = self._plan_cte(name, cq, ctes)
         node = self._plan_body(q.body, outer, ctes, q.order_by, q.limit)
         if top:
-            node.cte_segments = list(self.cte_segments)
+            # fresh root annotation, never a shared node's field
+            node.cte_segments = list(self.cte_segments)  # lint: frozen-exempt (root annotation)
+            pipe = PassPipeline(self.catalog.verify_plans, self.catalog)
+            pipe.check("bind", node)
             if self.catalog.late_mat and \
                     not os.environ.get("NDS_TPU_NO_LATE_MAT"):
                 # BEFORE pruning: the declaration-order permutation projects
                 # are still full-width bijections, so the surrogate join key
                 # is expressible in the aggregate's input space (pruning
                 # would have dropped it — nothing above the join consumes it)
-                node2 = _late_materialization(node, self.catalog)
-                if node2 is not node:
-                    segs = getattr(node, "cte_segments", [])
-                    live = {id(n) for n in P.iter_plan_nodes(node2)}
-                    node2.cte_segments = [(fp, n) for fp, n in segs
-                                          if id(n) in live]
-                    node = node2
+                node = pipe.run("late_materialization",
+                                lambda p: self._seg_live(
+                                    p, _late_materialization(p, self.catalog)),
+                                node)
             if not os.environ.get("NDS_TPU_NO_COLPRUNE"):
                 from .colprune import prune_plan
-                node = prune_plan(node)
+                node = pipe.run("colprune", prune_plan, node)
             if not os.environ.get("NDS_TPU_NO_SELFJOIN_REWRITE"):
                 # AFTER pruning (dead columns would hide the single-column
                 # key-set shape), and pruned again when it fired (the
                 # rewrite kills the pair-expansion column uses)
-                node2 = _selfjoin_distinct_rewrite(node)
+                node2 = pipe.run("selfjoin_distinct",
+                                 lambda p: self._seg_live(
+                                     p, _selfjoin_distinct_rewrite(p)),
+                                 node)
                 if node2 is not node:
-                    segs = getattr(node, "cte_segments", [])
-                    live = {id(n) for n in P.iter_plan_nodes(node2)}
-                    node2.cte_segments = [(fp, n) for fp, n in segs
-                                          if id(n) in live]
                     node = node2
                     if not os.environ.get("NDS_TPU_NO_COLPRUNE"):
                         from .colprune import prune_plan
-                        node = prune_plan(node)
+                        node = pipe.run("colprune", prune_plan, node)
+            node = pipe.finish(node)
         return node
+
+    @staticmethod
+    def _seg_live(old: P.PlanNode, new: P.PlanNode) -> P.PlanNode:
+        """Carry cte_segments across a rewrite, dropping entries no longer
+        reachable from the rewritten root."""
+        if new is old:
+            return new
+        segs = getattr(old, "cte_segments", [])
+        live = {id(n) for n in P.iter_plan_nodes(new)}
+        new.cte_segments = [(fp, n) for fp, n in segs if id(n) in live]  # lint: frozen-exempt (root annotation)
+        return new
 
     def _plan_cte(self, name: str, cq: A.Query, ctes: dict) -> P.PlanNode:
         """Plan one WITH entry and register it as a segmentation candidate."""
@@ -754,8 +852,8 @@ class Planner:
         if not lkeys:
             raise PlanError("uncorrelated EXISTS in a nested position "
                             "is unsupported")
-        key_exprs = [P.BCol(k.dtype, k.index, f"mk{i}")
-                     for i, k in enumerate(rkeys)]
+        key_exprs = [P.BCol(k.dtype, k.index, sub_plan.out_names[k.index])
+                     for k in rkeys]
         names = [f"mk{i}" for i in range(len(key_exprs))]
         dtypes = [k.dtype for k in rkeys]
         proj = P.ProjectNode(sub_plan, key_exprs, out_names=names,
@@ -854,7 +952,8 @@ class Planner:
                              out_dtypes=cur.out_dtypes + derived.out_dtypes)
             # value column is the last output of derived
             value_idx = width + len(derived.out_names) - 1
-            rewritten[id(sq)] = P.BCol(value_dtype, value_idx, "__scalar")
+            rewritten[id(sq)] = P.BCol(value_dtype, value_idx,
+                                       derived.out_names[-1])
         # keep original entries (with qualifiers) and extend with joined cols
         entries = list(scope.entries)
         for i in range(len(scope.entries), len(cur.out_names)):
@@ -890,13 +989,19 @@ class Planner:
                 sel_exprs.append(binder.bind(it.expr))
         extra_exprs = [binder.bind(ie) for _, ie in corr]
         all_exprs = sel_exprs + extra_exprs
+        # output names mirror what each column IS — select items as c{i},
+        # correlation keys as k{i}, exposed inner columns by their own
+        # names — so downstream key/residual references resolve by name too
+        all_names = [f"c{i}" for i in range(len(sel_exprs))] + \
+                    [f"k{i}" for i in range(len(extra_exprs))]
         if mixed:
             # expose every inner column so the caller can bind the residual
             # over the combined [outer | subquery] schema
             all_exprs = all_exprs + [
                 P.BCol(e.dtype, e.index, e.name) for e in inner_scope.entries]
+            all_names = all_names + [e.name for e in inner_scope.entries]
         plan = P.ProjectNode(rel, all_exprs,
-                             out_names=[f"c{i}" for i in range(len(all_exprs))],
+                             out_names=all_names,
                              out_dtypes=[e.dtype for e in all_exprs])
         inner_keys = [P.BCol(e.dtype, len(sel_exprs) + i, f"k{i}")
                       for i, e in enumerate(extra_exprs)]
@@ -1120,13 +1225,16 @@ class Planner:
                      for si in fc.over.order_by]
             funcs.append(P.WindowFunc(func, arg, part, okeys,
                                       name=_display_name(fc)))
-        for f in funcs:
+        for i, f in enumerate(funcs):
             if f.func in ("rank", "dense_rank") and f.order_by:
                 new_keys = []
                 for k in f.order_by:
                     rel, ks = self._exact_rational_keys(rel, k)
                     new_keys.extend(ks)
-                f.order_by = new_keys
+                # copy-on-write, like every other plan-IR rewrite: mutating
+                # the WindowFunc in place would trip the freeze lint even
+                # though this list is planner-local
+                funcs[i] = replace(f, order_by=new_keys)
         out_names = list(rel.out_names) + [f.name for f in funcs]
         out_dtypes = list(rel.out_dtypes) + [f.dtype for f in funcs]
         node = P.WindowNode(rel, funcs, out_names=out_names,
@@ -1389,7 +1497,7 @@ def _try_late_mat(agg: P.AggregateNode, catalog: "Catalog",
                 slot[ci] = len(pkeys)
                 src = elig[ci]["key_top"]
                 pkeys.append(P.BCol(agg.child.out_dtypes[src], src,
-                                    "__lm_key"))
+                                    agg.child.out_names[src]))
         else:
             plain_slot[i] = len(pkeys)
             pkeys.append(g)
@@ -1409,7 +1517,8 @@ def _try_late_mat(agg: P.AggregateNode, catalog: "Catalog",
         kidx = c["kidx"]
         cur2 = P.JoinNode(
             cur2, rc, "inner",
-            left_keys=[P.BCol(pkeys[slot[ci]].dtype, slot[ci], "__lm_key")],
+            left_keys=[P.BCol(pkeys[slot[ci]].dtype, slot[ci],
+                              f"__lm_k{slot[ci]}")],
             right_keys=[P.BCol(rc.out_dtypes[kidx], kidx,
                                rc.out_names[kidx])],
             residual=None, late_mat=True,
@@ -1421,13 +1530,12 @@ def _try_late_mat(agg: P.AggregateNode, catalog: "Catalog",
     for i, (g, cl) in enumerate(zip(agg.group_exprs, gclass)):
         if cl is not None and cl[0] in elig:
             ci, gcol = cl
-            exprs.append(P.BCol(g.dtype,
-                                dim_off[ci] + (gcol - elig[ci]["off"]),
-                                p_names[i]))
+            idx = dim_off[ci] + (gcol - elig[ci]["off"])
         else:
-            exprs.append(P.BCol(g.dtype, plain_slot[i], p_names[i]))
+            idx = plain_slot[i]
+        exprs.append(P.BCol(g.dtype, idx, cur2.out_names[idx]))
     for j in range(len(partial_specs)):
-        exprs.append(P.BCol(p_dtypes[n + j], m + j, p_names[n + j]))
+        exprs.append(P.BCol(p_dtypes[n + j], m + j, cur2.out_names[m + j]))
     proj = P.ProjectNode(cur2, exprs, out_names=list(p_names),
                          out_dtypes=list(p_dtypes))
     return _final_builder(agg, recipes, p_names, p_dtypes)(proj)
@@ -1471,7 +1579,7 @@ def _late_materialization(plan: P.PlanNode, catalog: "Catalog") -> P.PlanNode:
         segs = getattr(plan, "cte_segments", None)
         plan = substitute_nodes(plan, mapping)
         if segs is not None and not hasattr(plan, "cte_segments"):
-            plan.cte_segments = segs
+            plan.cte_segments = segs  # lint: frozen-exempt (root annotation)
     return plan
 
 

@@ -316,7 +316,8 @@ class Session:
                        dec_enabled=self._dec_as_int(),
                        unique_cols=dict(self._unique_cols),
                        late_mat=self.config.late_materialization,
-                       late_mat_min_rows=self.config.late_mat_min_rows)
+                       late_mat_min_rows=self.config.late_mat_min_rows,
+                       verify_plans=self.config.verify_plans)
 
     def sql(self, query: str, backend: Optional[str] = None) -> Table:
         """Run a query; backend "jax" (device) or "numpy" (host oracle).
@@ -395,6 +396,10 @@ class Session:
                 return None
             groups = streaming.plan_scan_groups(jobs,
                                                 self.config.shared_scan)
+            if self.config.verify_plans == "per-pass":
+                # fused shared-scan partial plans are plan-IR rewrites that
+                # never pass through planner.PassPipeline — verify them here
+                streaming.verify_groups(groups)
             # ONE executor serves every group of every job: groups run
             # sequentially, and sharing the scan cache uploads each
             # dimension table once instead of per branch

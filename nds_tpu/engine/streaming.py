@@ -553,6 +553,24 @@ def plan_scan_groups(jobs: list[StreamJob], shared: bool) -> list[ScanGroup]:
     return groups
 
 
+def verify_groups(groups: list[ScanGroup]) -> None:
+    """Static verification of shared-scan fused partial plans: fuse_group
+    rewrites every member's morsel scan into a union-column view, which is
+    a plan-IR transform like any planner pass — a bad column mapping there
+    silently serves one branch another branch's columns. Run by the
+    session when EngineConfig.verify_plans == "per-pass" (the groups never
+    flow through planner.PassPipeline); raises PlanVerifyError naming the
+    group/member as the offending pass."""
+    from .verify import PlanVerifyError, verify_plan
+
+    for gi, g in enumerate(groups):
+        for mi, p in enumerate(g.plans):
+            findings = verify_plan(p)
+            if findings:
+                raise PlanVerifyError(
+                    findings, f"stream_fusion[group {gi} member {mi}]")
+
+
 def _expr_subplans(node: PlanNode):
     """Plans embedded in this node's EXPRESSIONS (BScalarSubquery) —
     q9-class scalar-subquery aggregates over big scans live there."""

@@ -1,0 +1,742 @@
+"""Static plan-IR verifier: machine-checked invariants between rewrite passes.
+
+The planner is the riskiest layer of the engine — five rewrite passes
+(binder typing/coercion, column pruning, self-join distinct rewrite, late
+materialization, parameter hoisting) plus shared-scan grouping all transform
+one plan IR, and a pass that silently violates an invariant (a dangling
+column index, an in-place widening of a shared CTE subtree, a dtype that no
+longer matches the binder's declaration) executes into wrong answers or
+shape errors far from the cause. Flare-class native SQL compilers live or
+die on IR invariants holding between passes (PAPERS.md); this module checks
+each plan WITHOUT executing it:
+
+- output-schema/arity consistency per node kind (a JoinNode's output is
+  exactly left‖right, a FilterNode is width-preserving, ...), which also
+  catches the in-place shared-subtree widening hazard (the parent's stored
+  schema no longer matches its mutated child);
+- column references: every BCol resolves against its input relation by
+  index, dtype, AND (when the reference carries one) name;
+- dtype inference agreement: an independent re-implementation of the
+  binder's coercion rules (`_common_dtype`, `_arith_dtype`, decimal scale
+  arithmetic) re-derives every BCall's dtype from its arguments and compares
+  with the declared dtype — double-entry bookkeeping against binder bugs;
+- aggregate/window legality: group keys and aggregate arguments bind in the
+  child's space, aggregate functions/argument dtypes are legal, and for
+  streaming-mergeable aggregates the partial/final decomposition round-trips
+  to the aggregate's exact output schema;
+- join-key dtype compatibility (a float-vs-int key pair compares IEEE key
+  bits against raw integers in the executors — silently empty joins);
+- DAG-sharing discipline: `snapshot`/`check_frozen` fingerprint every node
+  before a pass and prove nodes surviving the pass (same object identity)
+  are structurally unchanged — the exact class of bug `_exact_rational_keys`
+  had before it rebuilt chains copy-on-write (ADVICE r5);
+- parameter round-trip: `parameterize_plan`/`deparameterize_plan`
+  reconstruct a structurally identical plan.
+
+`planner.PassPipeline` runs these checks between passes under
+`EngineConfig.verify_plans = off|final|per-pass`; a violation raises
+`PlanVerifyError` naming the offending node and the pass that introduced it.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from . import plan as P
+from .column import dec_dtype, dec_scale, is_dec
+
+_SIMPLE_DTYPES = frozenset({"int", "float", "bool", "date", "str"})
+
+# ops the expression evaluators implement (exprs._HANDLERS / jexprs): an op
+# outside this set can never execute
+_KNOWN_OPS = frozenset({
+    "add", "sub", "mul", "div", "mod", "neg", "ratdiv_hi", "ratdiv_lo",
+    "eq", "ne", "lt", "le", "gt", "ge", "and", "or", "not",
+    "isnull", "isnotnull", "in_list", "like", "case", "coalesce", "cast",
+    "substr", "concat", "abs", "round", "upper", "lower", "nullif",
+    "grouping_bit",
+})
+
+_BOOL_OPS = frozenset({"eq", "ne", "lt", "le", "gt", "ge", "and", "or",
+                       "not", "isnull", "isnotnull", "in_list", "like"})
+
+_AGG_FUNCS = frozenset({"sum", "count", "count_star", "avg", "min", "max",
+                        "stddev_samp"})
+_WINDOW_FUNCS = frozenset({"rank", "dense_rank", "row_number", "sum", "avg",
+                           "min", "max", "count", "count_star"})
+_JOIN_KINDS = frozenset({"inner", "left", "right", "full", "cross", "semi",
+                         "anti"})
+
+
+@dataclasses.dataclass
+class Finding:
+    """One invariant violation, anchored to a plan node."""
+    node: object            # the offending PlanNode
+    label: str              # stable preorder label, e.g. "ProjectNode#4"
+    kind: str               # arity | colref | colname | dtype | agg | window
+    #                       | joinkey | setop | scan | frozen | params
+    message: str
+
+    def __str__(self) -> str:
+        return f"[{self.label}] {self.kind}: {self.message}"
+
+
+class PlanVerifyError(ValueError):
+    """A rewrite pass produced (or started from) an invalid plan."""
+
+    def __init__(self, findings: list[Finding], pass_name: str):
+        self.findings = findings
+        self.pass_name = pass_name
+        head = "; ".join(str(f) for f in findings[:3])
+        more = f" (+{len(findings) - 3} more)" if len(findings) > 3 else ""
+        super().__init__(
+            f"plan verification failed after pass {pass_name!r}: "
+            f"{len(findings)} finding(s): {head}{more}")
+
+
+def node_labels(root: P.PlanNode) -> dict[int, str]:
+    """Stable preorder labels for every distinct node: 'TypeName#k'. The
+    same plan object always labels identically, so errors and tests can
+    name nodes without relying on id() values."""
+    labels: dict[int, str] = {}
+    counts: dict[str, int] = {}
+    for n in P.iter_plan_nodes(root):
+        t = type(n).__name__
+        counts[t] = counts.get(t, 0) + 1
+        labels[id(n)] = f"{t}#{counts[t] - 1}"
+    return labels
+
+
+def plan_fingerprint(node, _memo: Optional[dict] = None) -> int:
+    """Structural fingerprint of a plan/expression subtree, memoized on
+    object identity so shared-CTE DAGs hash in linear time. An int hash
+    (not cryptographic): two structurally identical trees always agree;
+    disagreement proves a structural difference within this process.
+    Identity-hashes MaterializedNode payloads (their Tables hold data,
+    not structure)."""
+    memo: dict[int, int] = _memo if _memo is not None else {}
+
+    def rec(x) -> int:
+        if isinstance(x, (str, int, float, bool)) or x is None:
+            return hash((type(x).__name__, x))
+        if isinstance(x, (list, tuple)):
+            return hash(tuple(map(rec, x)))
+        if dataclasses.is_dataclass(x) and not isinstance(x, type):
+            got = memo.get(id(x))
+            if got is not None:
+                return got
+            if isinstance(x, P.MaterializedNode):
+                out = hash(("mat", id(x)))
+            else:
+                out = hash((type(x).__name__,) + tuple(
+                    rec(getattr(x, name)) for name in P.type_fields(x)))
+            memo[id(x)] = out
+            return out
+        return hash(repr(x))
+
+    return rec(node)
+
+
+def snapshot(root: P.PlanNode) -> dict[int, tuple]:
+    """Per-node structural fingerprints BEFORE a rewrite pass, keyed by
+    object identity — input to check_frozen. Holds a reference to each
+    node: a pass may drop subtrees, and a recycled id of a freed node
+    colliding with a new node would otherwise corrupt the comparison."""
+    return frozen_scan(root, None)[1]
+
+
+def frozen_scan(root: P.PlanNode, before: Optional[dict],
+                labels: Optional[dict[int, str]] = None
+                ) -> tuple[list[Finding], dict[int, tuple]]:
+    """One fingerprint walk doing double duty: compare surviving nodes
+    against `before` (None = first scan, nothing to compare) AND return the
+    new plan's own snapshot, so a pass pipeline pays ONE walk per pass
+    instead of a snapshot walk plus a check walk.
+
+    Copy-on-write passes must REPLACE nodes, never mutate them — a shared
+    subtree widened in place shifts positional bindings for every other
+    consumer. Reports the DEEPEST mutated node(s): an ancestor's
+    fingerprint changes whenever a descendant's does, so only nodes with no
+    mutated surviving plan-child are named."""
+    memo: dict[int, int] = {}
+    after: dict[int, tuple] = {}
+    mutated: dict[int, P.PlanNode] = {}
+    for n in P.iter_plan_nodes(root):
+        fp = plan_fingerprint(n, memo)
+        after[id(n)] = (fp, n)
+        old = before.get(id(n)) if before is not None else None
+        if old is not None and old[1] is n and fp != old[0]:
+            mutated[id(n)] = n
+    out: list[Finding] = []
+    for n in mutated.values():
+        subs = [getattr(n, f, None) for f in ("child", "left", "right")]
+        if any(isinstance(s, P.PlanNode) and id(s) in mutated for s in subs):
+            continue
+        out.append(Finding(n, "", "frozen",
+                           "node mutated in place by a rewrite pass "
+                           "(shared subtrees are structurally frozen; "
+                           "rebuild copy-on-write instead)"))
+    _fill_labels(out, root, labels)
+    return out, after
+
+
+def check_frozen(root: P.PlanNode, before: dict[int, tuple],
+                 labels: Optional[dict[int, str]] = None) -> list[Finding]:
+    """Findings-only view of frozen_scan against a prior snapshot()."""
+    return frozen_scan(root, before, labels)[0]
+
+
+def _fill_labels(findings: list[Finding], root: P.PlanNode,
+                 labels: Optional[dict[int, str]]) -> None:
+    """Assign node labels AFTER checking: findings are the rare case, so
+    the labeling walk is deferred until one exists."""
+    if not findings:
+        return
+    if labels is None:
+        labels = node_labels(root)
+    for f in findings:
+        if not f.label:
+            f.label = labels.get(id(f.node), type(f.node).__name__)
+
+
+# ---------------------------------------------------------------------------
+# dtype rules — an independent re-implementation of the binder's coercion
+# conventions (planner._arith_dtype / _common_dtype / _coerce_pair)
+# ---------------------------------------------------------------------------
+
+def _dtype_ok(dtype: str) -> bool:
+    return dtype in _SIMPLE_DTYPES or is_dec(dtype)
+
+
+def _numeric(dtype: str) -> bool:
+    return dtype in ("int", "float") or is_dec(dtype)
+
+
+def _comparable(a: str, b: str) -> bool:
+    """May two dtypes meet in a comparison? Lenient where the executors are
+    (mixed numerics compare fine), strict where they are not: a string can
+    only meet a string, and two decimals must share a scale (their physical
+    values are scale-dependent integers)."""
+    if a == b:
+        return True
+    if "str" in (a, b):
+        return False
+    if is_dec(a) and is_dec(b):
+        return dec_scale(a) == dec_scale(b)
+    return True
+
+
+def _join_key_ok(a: str, b: str) -> bool:
+    """Equi-join keys factorize through ops.key_array into one int64 space:
+    float keys map to IEEE order-preserving bit patterns, int/date keys to
+    raw values, decimals to scaled integers. Mixed representations compare
+    garbage, so key pairs must agree on representation."""
+    if a == b:
+        return True
+    if {a, b} <= {"int", "date"}:
+        return True        # both raw integer day numbers / surrogate keys
+    if is_dec(a) and is_dec(b):
+        return dec_scale(a) == dec_scale(b)
+    return False
+
+
+def _arith_result(op: str, a: str, b: str) -> Optional[set[str]]:
+    """Acceptable result dtypes of a binary arithmetic op, or None when the
+    operand pair itself is illegal. Mirrors planner._arith_dtype."""
+    if "str" in (a, b) or "bool" in (a, b):
+        return None
+    if op == "div":
+        return {"float"}
+    if a == "date" or b == "date":
+        if a == "date" and b == "date":
+            return {"int"}
+        if "float" in (a, b) or is_dec(a) or is_dec(b):
+            return None
+        return {"date"}
+    da, db = is_dec(a), is_dec(b)
+    if da or db:
+        if a == "float" or b == "float" or op == "mod":
+            return {"float"}
+        if op == "mul":
+            return {dec_dtype((dec_scale(a) if da else 0) +
+                              (dec_scale(b) if db else 0))}
+        # add/sub: operands must arrive scale-aligned (dec vs dec) or be
+        # dec vs int folded by the binder; result keeps the dec scale
+        if da and db and dec_scale(a) != dec_scale(b):
+            return None
+        return {a if da else b}
+    if a == "float" or b == "float":
+        return {"float"}
+    return {"int"}
+
+
+def _check_call(e: P.BCall, add) -> None:
+    """Op-specific dtype agreement for one BCall (args already checked)."""
+    op = e.op
+    a = [x.dtype for x in e.args]
+    if op not in _KNOWN_OPS:
+        add("dtype", f"unknown op {op!r}")
+        return
+    if op in _BOOL_OPS and e.dtype != "bool":
+        add("dtype", f"{op} declares {e.dtype!r}, expected 'bool'")
+    if op in ("eq", "ne", "lt", "le", "gt", "ge"):
+        if len(a) == 2 and not _comparable(a[0], a[1]):
+            add("dtype", f"{op} over incomparable dtypes {a[0]!r}/{a[1]!r}")
+    elif op in ("and", "or", "not"):
+        for d in a:
+            if d != "bool":
+                add("dtype", f"{op} argument dtype {d!r}, expected 'bool'")
+    elif op == "like":
+        if a and a[0] != "str":
+            add("dtype", f"like over non-string dtype {a[0]!r}")
+    elif op in ("add", "sub", "mul", "div", "mod"):
+        if len(a) == 2:
+            ok = _arith_result(op, a[0], a[1])
+            if ok is None:
+                add("dtype", f"{op} over illegal dtypes {a[0]!r}/{a[1]!r}")
+            elif e.dtype not in ok:
+                add("dtype", f"{op}({a[0]}, {a[1]}) declares {e.dtype!r}, "
+                             f"expected one of {sorted(ok)}")
+    elif op in ("neg", "abs"):
+        if a and e.dtype != a[0]:
+            add("dtype", f"{op} declares {e.dtype!r} != arg {a[0]!r}")
+        if a and not _numeric(a[0]):
+            add("dtype", f"{op} over non-numeric dtype {a[0]!r}")
+    elif op in ("ratdiv_hi", "ratdiv_lo"):
+        if e.dtype != "int":
+            add("dtype", f"{op} declares {e.dtype!r}, expected 'int'")
+    elif op == "case":
+        if len(e.args) % 2 == 0:
+            add("dtype", f"case with even arg count {len(e.args)}")
+        else:
+            for i in range(0, len(e.args) - 1, 2):
+                if a[i] != "bool":
+                    add("dtype", f"case condition {i // 2} dtype {a[i]!r}, "
+                                 "expected 'bool'")
+            for i in list(range(1, len(e.args) - 1, 2)) + [len(e.args) - 1]:
+                if a[i] != e.dtype:
+                    add("dtype", f"case branch dtype {a[i]!r} != declared "
+                                 f"{e.dtype!r}")
+    elif op == "coalesce":
+        for d in a:
+            if d != e.dtype:
+                add("dtype", f"coalesce argument dtype {d!r} != declared "
+                             f"{e.dtype!r}")
+    elif op == "nullif":
+        if a and e.dtype != a[0]:
+            add("dtype", f"nullif declares {e.dtype!r} != arg {a[0]!r}")
+    elif op in ("substr", "concat", "upper", "lower"):
+        if e.dtype != "str":
+            add("dtype", f"{op} declares {e.dtype!r}, expected 'str'")
+    elif op == "round":
+        if e.dtype != "float" and not is_dec(e.dtype):
+            add("dtype", f"round declares {e.dtype!r}, expected float/dec")
+    elif op == "grouping_bit":
+        if e.dtype != "int":
+            add("dtype", f"grouping_bit declares {e.dtype!r}, expected 'int'")
+    # cast/isnull/isnotnull/in_list: declared dtype is the contract itself
+
+
+class _Verifier:
+    def __init__(self, catalog=None):
+        self.catalog = catalog
+        self.findings: list[Finding] = []
+
+    def _add(self, node, kind: str, message: str) -> None:
+        # labels are filled in bulk by verify_plan iff findings exist
+        self.findings.append(Finding(node, "", kind, message))
+
+    # -- expressions --------------------------------------------------------
+    def _expr(self, node, e, names: list[str], dtypes: list[str],
+              where: str) -> None:
+        """Check one expression bound against the input schema
+        (names/dtypes); `where` situates the message (predicate, key, ...)."""
+        if isinstance(e, P.BCol):
+            if not (0 <= e.index < len(dtypes)):
+                self._add(node, "colref",
+                          f"{where}: BCol index {e.index} out of range "
+                          f"(input width {len(dtypes)})")
+                return
+            if e.dtype != dtypes[e.index]:
+                self._add(node, "dtype",
+                          f"{where}: BCol #{e.index} declares {e.dtype!r} "
+                          f"but input column "
+                          f"{names[e.index]!r} is {dtypes[e.index]!r}")
+            if e.name and e.name != names[e.index]:
+                self._add(node, "colname",
+                          f"{where}: BCol #{e.index} named {e.name!r} but "
+                          f"input column is {names[e.index]!r}")
+            return
+        if isinstance(e, P.BLit):
+            if not _dtype_ok(e.dtype):
+                self._add(node, "dtype",
+                          f"{where}: literal dtype {e.dtype!r} unknown")
+            return
+        if isinstance(e, P.BParam):
+            if not _dtype_ok(e.dtype):
+                self._add(node, "dtype",
+                          f"{where}: param dtype {e.dtype!r} unknown")
+            return
+        if isinstance(e, P.BScalarSubquery):
+            # the subplan itself is verified by the node sweep
+            # (iter_plan_nodes descends expression-embedded plans)
+            w = len(e.plan.out_dtypes)
+            if w != 1:
+                self._add(node, "arity",
+                          f"{where}: scalar subquery returns {w} columns")
+            elif e.dtype != e.plan.out_dtypes[0]:
+                self._add(node, "dtype",
+                          f"{where}: scalar subquery declares {e.dtype!r} "
+                          f"but plan yields {e.plan.out_dtypes[0]!r}")
+            return
+        if isinstance(e, P.BCall):
+            for arg in e.args:
+                self._expr(node, arg, names, dtypes, where)
+            if isinstance(e.extra, list):    # in_list param slots
+                for v in e.extra:
+                    if isinstance(v, P.BParam) and not _dtype_ok(v.dtype):
+                        self._add(node, "dtype",
+                                  f"{where}: in_list param dtype "
+                                  f"{v.dtype!r} unknown")
+            _check_call(e, lambda kind, msg: self._add(
+                node, kind, f"{where}: {msg}"))
+            return
+        self._add(node, "dtype",
+                  f"{where}: unexpected expression {type(e).__name__}")
+
+    # -- nodes --------------------------------------------------------------
+    def check_node(self, n: P.PlanNode) -> None:
+        if len(n.out_names) != len(n.out_dtypes):
+            self._add(n, "arity",
+                      f"{len(n.out_names)} names vs "
+                      f"{len(n.out_dtypes)} dtypes")
+            return
+        for d in n.out_dtypes:
+            if not _dtype_ok(d):
+                self._add(n, "dtype", f"output dtype {d!r} unknown")
+        w = len(n.out_names)
+        meth = getattr(self, "_chk_" + type(n).__name__, None)
+        if meth is not None:
+            meth(n, w)
+
+    def _require_passthrough(self, n, w: int) -> None:
+        c = n.child
+        if w != len(c.out_names):
+            self._add(n, "arity",
+                      f"width {w} != child width {len(c.out_names)} "
+                      "(width-preserving node)")
+            return
+        if list(n.out_dtypes) != list(c.out_dtypes):
+            self._add(n, "dtype", "output dtypes diverge from child's "
+                                  "(width-preserving node)")
+
+    def _chk_ScanNode(self, n: P.ScanNode, w: int) -> None:
+        from .streaming import MORSEL_TABLE  # lazy: streaming is heavier
+        if len(n.columns) != w:
+            self._add(n, "arity",
+                      f"{len(n.columns)} physical columns vs width {w}")
+            return
+        if list(n.out_names) != list(n.columns):
+            self._add(n, "scan", "out_names diverge from physical columns")
+        if self.catalog is None or n.table.startswith(MORSEL_TABLE):
+            return
+        try:
+            names, dtypes = self.catalog.schema(n.table)
+        except Exception:
+            self._add(n, "scan", f"unknown table {n.table!r}")
+            return
+        pos = {c: i for i, c in enumerate(names)}
+        for c, d in zip(n.columns, n.out_dtypes):
+            if c not in pos:
+                self._add(n, "scan",
+                          f"column {c!r} not in table {n.table!r}")
+            elif dtypes[pos[c]] != d:
+                self._add(n, "dtype",
+                          f"column {n.table}.{c} is {dtypes[pos[c]]!r} in "
+                          f"the catalog but scans as {d!r}")
+
+    def _chk_FilterNode(self, n: P.FilterNode, w: int) -> None:
+        self._require_passthrough(n, w)
+        c = n.child
+        self._expr(n, n.predicate, c.out_names, c.out_dtypes, "predicate")
+        if n.predicate.dtype != "bool":
+            self._add(n, "dtype",
+                      f"predicate dtype {n.predicate.dtype!r}, "
+                      "expected 'bool'")
+
+    def _chk_ProjectNode(self, n: P.ProjectNode, w: int) -> None:
+        if len(n.exprs) != w:
+            self._add(n, "arity", f"{len(n.exprs)} exprs vs width {w}")
+            return
+        c = n.child
+        for i, e in enumerate(n.exprs):
+            self._expr(n, e, c.out_names, c.out_dtypes, f"expr {i}")
+            if e.dtype != n.out_dtypes[i]:
+                self._add(n, "dtype",
+                          f"expr {i} ({n.out_names[i]!r}) has dtype "
+                          f"{e.dtype!r} but output declares "
+                          f"{n.out_dtypes[i]!r}")
+
+    def _chk_JoinNode(self, n: P.JoinNode, w: int) -> None:
+        lw, rw = len(n.left.out_names), len(n.right.out_names)
+        if n.kind not in _JOIN_KINDS:
+            self._add(n, "arity", f"unknown join kind {n.kind!r}")
+        if n.kind in ("semi", "anti"):
+            if w != lw or list(n.out_dtypes) != list(n.left.out_dtypes):
+                self._add(n, "arity",
+                          f"{n.kind} join output must equal its left "
+                          f"schema (width {w} vs {lw})")
+        else:
+            if w != lw + rw:
+                self._add(n, "arity",
+                          f"join width {w} != left {lw} + right {rw}")
+            elif list(n.out_dtypes) != \
+                    list(n.left.out_dtypes) + list(n.right.out_dtypes):
+                self._add(n, "dtype",
+                          "join output dtypes diverge from left‖right")
+        if n.null_aware and n.kind != "anti":
+            self._add(n, "arity", "null_aware on a non-anti join")
+        if len(n.left_keys) != len(n.right_keys):
+            self._add(n, "joinkey",
+                      f"{len(n.left_keys)} left keys vs "
+                      f"{len(n.right_keys)} right keys")
+        for i, k in enumerate(n.left_keys):
+            self._expr(n, k, n.left.out_names, n.left.out_dtypes,
+                       f"left key {i}")
+        for i, k in enumerate(n.right_keys):
+            self._expr(n, k, n.right.out_names, n.right.out_dtypes,
+                       f"right key {i}")
+        for i, (lk, rk) in enumerate(zip(n.left_keys, n.right_keys)):
+            if not _join_key_ok(lk.dtype, rk.dtype):
+                self._add(n, "joinkey",
+                          f"key {i} dtypes {lk.dtype!r} vs {rk.dtype!r} "
+                          "factorize into different int64 key spaces")
+        if n.residual is not None:
+            comb_names = list(n.left.out_names) + list(n.right.out_names)
+            comb_dtypes = list(n.left.out_dtypes) + list(n.right.out_dtypes)
+            self._expr(n, n.residual, comb_names, comb_dtypes, "residual")
+            if n.residual.dtype != "bool":
+                self._add(n, "dtype",
+                          f"residual dtype {n.residual.dtype!r}, "
+                          "expected 'bool'")
+
+    def _chk_AggregateNode(self, n: P.AggregateNode, w: int) -> None:
+        c = n.child
+        ng, na = len(n.group_exprs), len(n.aggs)
+        expect = ng + na + (1 if n.rollup else 0)
+        if w != expect:
+            self._add(n, "arity",
+                      f"aggregate width {w} != {ng} groups + {na} aggs"
+                      f"{' + __grouping_id' if n.rollup else ''}")
+            return
+        for i, g in enumerate(n.group_exprs):
+            self._expr(n, g, c.out_names, c.out_dtypes, f"group key {i}")
+            if g.dtype != n.out_dtypes[i]:
+                self._add(n, "dtype",
+                          f"group key {i} dtype {g.dtype!r} != output "
+                          f"{n.out_dtypes[i]!r}")
+        for i, s in enumerate(n.aggs):
+            if s.func not in _AGG_FUNCS:
+                self._add(n, "agg", f"unknown aggregate {s.func!r}")
+                continue
+            if s.func == "count_star":
+                if s.arg is not None:
+                    self._add(n, "agg", "count_star with an argument")
+            elif s.arg is None:
+                self._add(n, "agg", f"{s.func} without an argument")
+            if s.arg is not None:
+                self._expr(n, s.arg, c.out_names, c.out_dtypes,
+                           f"agg {i} ({s.func})")
+                if s.func in ("sum", "avg", "stddev_samp") \
+                        and not _numeric(s.arg.dtype) \
+                        and s.arg.dtype != "bool":
+                    self._add(n, "agg",
+                              f"{s.func} over non-numeric dtype "
+                              f"{s.arg.dtype!r}")
+            if s.dtype != n.out_dtypes[ng + i]:
+                self._add(n, "dtype",
+                          f"agg {i} ({s.func}) dtype {s.dtype!r} != output "
+                          f"{n.out_dtypes[ng + i]!r}")
+        if n.rollup and n.out_dtypes[-1] != "int":
+            self._add(n, "dtype", "__grouping_id output dtype must be 'int'")
+        if n.rollup_levels is not None:
+            if not n.rollup:
+                self._add(n, "agg", "rollup_levels on a non-rollup aggregate")
+            for lvl in n.rollup_levels:
+                if not (0 <= lvl <= ng):
+                    self._add(n, "agg",
+                              f"rollup level {lvl} out of range 0..{ng}")
+        self._chk_decompose(n)
+
+    def _chk_decompose(self, n: P.AggregateNode) -> None:
+        """Streaming mergeability round-trip: the partial/final decomposition
+        of a mergeable aggregate must rebuild EXACTLY the aggregate's output
+        schema (the merge plan runs over materialized partials — a schema
+        drift here surfaces as silent mis-merged results mid-stream)."""
+        from . import streaming
+        if not streaming._mergeable(n):
+            return
+        try:
+            specs, recipes, p_names, p_dtypes = streaming._decompose(n)
+            mat = P.MaterializedNode(table=None, label="verify",
+                                     out_names=list(p_names),
+                                     out_dtypes=list(p_dtypes))
+            final = streaming._final_builder(n, recipes, p_names,
+                                             p_dtypes)(mat)
+        except Exception as e:
+            self._add(n, "agg",
+                      f"mergeable-agg decomposition failed: "
+                      f"{type(e).__name__}: {e}")
+            return
+        if list(final.out_names) != list(n.out_names) or \
+                list(final.out_dtypes) != list(n.out_dtypes):
+            self._add(n, "agg",
+                      "mergeable-agg decomposition does not round-trip to "
+                      "the aggregate's output schema")
+
+    def _chk_WindowNode(self, n: P.WindowNode, w: int) -> None:
+        c = n.child
+        cw = len(c.out_names)
+        if w != cw + len(n.funcs):
+            self._add(n, "arity",
+                      f"window width {w} != child {cw} + "
+                      f"{len(n.funcs)} funcs")
+            return
+        if list(n.out_dtypes[:cw]) != list(c.out_dtypes):
+            self._add(n, "dtype", "window passthrough dtypes diverge "
+                                  "from child's")
+        for i, f in enumerate(n.funcs):
+            if f.func not in _WINDOW_FUNCS:
+                self._add(n, "window", f"unknown window func {f.func!r}")
+                continue
+            if f.func in ("rank", "dense_rank", "row_number"):
+                if f.arg is not None:
+                    self._add(n, "window", f"{f.func} takes no argument")
+                if f.func in ("rank", "dense_rank") and not f.order_by:
+                    self._add(n, "window", f"{f.func} without ORDER BY")
+            if f.arg is not None:
+                self._expr(n, f.arg, c.out_names, c.out_dtypes,
+                           f"window {i} arg")
+            for j, e in enumerate(f.partition_by):
+                self._expr(n, e, c.out_names, c.out_dtypes,
+                           f"window {i} partition {j}")
+            for j, k in enumerate(f.order_by):
+                self._expr(n, k.expr, c.out_names, c.out_dtypes,
+                           f"window {i} order {j}")
+            if f.dtype != n.out_dtypes[cw + i]:
+                self._add(n, "dtype",
+                          f"window {i} ({f.func}) dtype {f.dtype!r} != "
+                          f"output {n.out_dtypes[cw + i]!r}")
+
+    def _chk_SortNode(self, n: P.SortNode, w: int) -> None:
+        self._require_passthrough(n, w)
+        c = n.child
+        for j, k in enumerate(n.keys):
+            self._expr(n, k.expr, c.out_names, c.out_dtypes, f"sort key {j}")
+
+    def _chk_LimitNode(self, n: P.LimitNode, w: int) -> None:
+        self._require_passthrough(n, w)
+        if n.n < 0:
+            self._add(n, "arity", f"negative limit {n.n}")
+
+    def _chk_DistinctNode(self, n: P.DistinctNode, w: int) -> None:
+        self._require_passthrough(n, w)
+
+    def _chk_SetOpNode(self, n: P.SetOpNode, w: int) -> None:
+        if n.op not in ("union", "intersect", "except"):
+            self._add(n, "setop", f"unknown set op {n.op!r}")
+        for side, b in (("left", n.left), ("right", n.right)):
+            if len(b.out_names) != w:
+                self._add(n, "arity",
+                          f"{side} branch width {len(b.out_names)} != {w}")
+            elif list(b.out_dtypes) != list(n.out_dtypes):
+                self._add(n, "setop",
+                          f"{side} branch dtypes diverge positionally "
+                          "(decimal scales must match before concat)")
+
+    def _chk_MaterializedNode(self, n: P.MaterializedNode, w: int) -> None:
+        t = n.table
+        if t is not None and getattr(t, "num_columns", w) != w:
+            self._add(n, "arity",
+                      f"materialized table has {t.num_columns} columns, "
+                      f"node declares {w}")
+
+    def _chk_VirtualScanNode(self, n: P.VirtualScanNode, w: int) -> None:
+        if not n.key:
+            self._add(n, "scan", "virtual scan without a segment key")
+
+
+def check_params(root: P.PlanNode) -> list[Finding]:
+    """parameterize_plan/deparameterize_plan round-trip integrity: the
+    hoisted plan must carry one slot per value and substitute back into a
+    structurally identical plan (a drift here means stream variants of one
+    template compile DIFFERENT programs — the whole point of hoisting)."""
+    out: list[Finding] = []
+    if any(isinstance(e, P.BParam)
+           for n in P.iter_plan_nodes(root)
+           for e in _node_exprs(n)):
+        return out            # already parameterized: nothing to round-trip
+    p, values, dtypes = P.parameterize_plan(root)
+    if len(values) != len(dtypes):
+        out.append(Finding(root, "", "params",
+                           f"{len(values)} hoisted values vs "
+                           f"{len(dtypes)} dtypes"))
+        return out
+    for n in P.iter_plan_nodes(p):
+        for e in _node_exprs(n):
+            for prm in _iter_params(e):
+                if not (0 <= prm.index < len(values)):
+                    out.append(Finding(
+                        n, "", "params",
+                        f"param slot {prm.index} out of range "
+                        f"({len(values)} values)"))
+    back = P.deparameterize_plan(p, values)
+    if plan_fingerprint(back) != plan_fingerprint(root):
+        out.append(Finding(root, "", "params",
+                           "parameterize/deparameterize round-trip does not "
+                           "reconstruct the plan"))
+    return out
+
+
+def _node_exprs(n: P.PlanNode):
+    """Every expression object held directly by a plan node."""
+    for name in P.type_fields(n):
+        if name in ("child", "left", "right", "table"):
+            continue
+        v = getattr(n, name)
+        stack = [v]
+        while stack:
+            x = stack.pop()
+            if isinstance(x, P.BExpr):
+                yield x
+            elif isinstance(x, (P.AggSpec, P.SortKey, P.WindowFunc)):
+                for g in dataclasses.fields(x):
+                    stack.append(getattr(x, g.name))
+            elif isinstance(x, (list, tuple)):
+                stack.extend(x)
+
+
+def _iter_params(e):
+    stack = [e]
+    while stack:
+        x = stack.pop()
+        if isinstance(x, P.BParam):
+            yield x
+        elif isinstance(x, P.BCall):
+            stack.extend(x.args)
+            if isinstance(x.extra, list):
+                stack.extend(v for v in x.extra if isinstance(v, P.BParam))
+
+
+def verify_plan(root: P.PlanNode, catalog=None, deep: bool = False,
+                labels: Optional[dict[int, str]] = None) -> list[Finding]:
+    """Statically check every invariant of a bound plan; returns findings
+    (empty = verified). `deep` adds the parameter round-trip check (one
+    extra structural pass — PassPipeline runs it on the final plan only)."""
+    v = _Verifier(catalog)
+    for n in P.iter_plan_nodes(root):
+        v.check_node(n)
+    if deep and not v.findings:
+        v.findings.extend(check_params(root))
+    _fill_labels(v.findings, root, labels)
+    return v.findings

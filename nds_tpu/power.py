@@ -165,7 +165,8 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
                      query_attempts: int | None = None,
                      resume: bool = False,
                      late_mat: bool | None = None,
-                     shared_scan: bool | None = None
+                     shared_scan: bool | None = None,
+                     verify_plans: str | None = None
                      ) -> list[tuple[str, int, int, int]]:
     """Run every query in the stream; returns (name, start_ms, end_ms, ms).
 
@@ -196,6 +197,8 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
     resume: skip queries already recorded in an existing (flushed partial)
     time log — a multi-hour stream interrupted mid-run restarts where it
     stopped, keeping the original Power Start Time.
+    verify_plans: static plan-IR verification mode (off|final|per-pass,
+    engine/verify.py) — None takes EngineConfig.verify_plans.
     """
     from .check import check_json_summary_folder, check_query_subset_exists
     from .config import maybe_enable_compile_cache
@@ -209,6 +212,8 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
         config.late_materialization = late_mat
     if shared_scan is not None:  # --no_shared_scan A/B override
         config.shared_scan = shared_scan
+    if verify_plans is not None:  # --verify_plans override
+        config.verify_plans = verify_plans
     session = Session(config)
     setup_tables(session, input_prefix, input_format)
 
@@ -444,6 +449,14 @@ def main(argv: list[str] | None = None) -> int:
                         "(group by surrogate keys, gather dimension "
                         "attributes after aggregation) for A/B runs; "
                         "property: nds.tpu.late_materialization")
+    p.add_argument("--verify_plans", default=None,
+                   choices=["off", "final", "per-pass"],
+                   help="static plan-IR verification (engine/verify.py): "
+                        "verify rewrite-pass invariants on every planned "
+                        "statement; per-pass attributes a violation to the "
+                        "pass that introduced it. Default from "
+                        "nds.tpu.verify_plans / NDS_TPU_VERIFY_PLANS "
+                        "(CI runs final, bench runs off)")
     p.add_argument("--no_shared_scan", action="store_true",
                    help="disable shared-scan morsel fusion (one streaming "
                         "pass per big table per query serving every "
@@ -462,7 +475,8 @@ def main(argv: list[str] | None = None) -> int:
                      query_timeout=a.query_timeout, query_attempts=a.retry,
                      resume=a.resume,
                      late_mat=False if a.no_late_mat else None,
-                     shared_scan=False if a.no_shared_scan else None)
+                     shared_scan=False if a.no_shared_scan else None,
+                     verify_plans=a.verify_plans)
     return 0
 
 

@@ -106,14 +106,14 @@ def test_ci_pipeline_script_runs():
     script = os.path.join(repo, "cicd", "run_ci.sh")
     out = subprocess.run(["bash", script, "--list"], capture_output=True,
                          text=True, check=True)
-    assert out.stdout.split() == ["native", "resilience", "planner", "test",
-                                  "bench", "all"]
+    assert out.stdout.split() == ["native", "resilience", "static",
+                                  "planner", "test", "bench", "all"]
     subprocess.run(["bash", script, "native"], check=True, timeout=600)
     import yaml
     with open(os.path.join(repo, "cicd", "ci.yml")) as f:
         wf = yaml.safe_load(f)
-    assert set(wf["jobs"]) == {"native", "resilience", "planner", "test",
-                               "bench"}
+    assert set(wf["jobs"]) == {"native", "resilience", "static", "planner",
+                               "test", "bench"}
     for job in wf["jobs"].values():
         assert any("run_ci.sh" in str(step.get("run", ""))
                    for step in job["steps"])

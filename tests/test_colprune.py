@@ -75,8 +75,13 @@ def test_shared_cte_stays_shared(data_dir):
     segs = getattr(plan, "cte_segments", [])
     assert len(segs) == 1
     seg_node = segs[0][1]
-    count = sum(1 for n in walk(plan) if n is seg_node)
+    # walk() is identity-memoized (shared nodes yield once), so count
+    # PARENT references instead of traversal visits
+    count = sum(1 for n in iter_plan_nodes(plan)
+                for f in ("child", "left", "right")
+                if getattr(n, f, None) is seg_node)
     assert count >= 2
+    assert sum(1 for n in walk(plan) if n is seg_node) == 1
 
 
 # a spread of plan shapes: correlated scalar subquery (1), multi-channel CTE

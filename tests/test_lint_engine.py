@@ -1,0 +1,177 @@
+"""Engine-discipline lint (scripts/lint_engine.py): regression pins.
+
+Two behaviors matter: the real tree lints CLEAN (the CI gate), and
+reintroducing either hazard class — an in-place mutation of a frozen
+PlanNode field, or an unlocked cross-thread attribute write — is flagged.
+"""
+import importlib.util
+import os
+import textwrap
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint():
+    import sys
+    spec = importlib.util.spec_from_file_location(
+        "lint_engine", os.path.join(_REPO, "scripts", "lint_engine.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["lint_engine"] = mod     # dataclasses resolves __module__
+    spec.loader.exec_module(mod)
+    return mod
+
+
+LINT = _lint()
+
+
+def _findings(src: str):
+    return LINT.lint_source("snippet.py", textwrap.dedent(src))
+
+
+# -- ENG001: frozen plan IR -------------------------------------------------
+
+def test_flags_reintroduced_plannode_mutation():
+    out = _findings("""
+        def widen(node, col):
+            node.out_names = node.out_names + [col]
+    """)
+    assert [f.rule for f in out] == ["ENG001"]
+    assert "out_names" in out[0].message
+
+
+def test_flags_subscript_and_mutating_calls():
+    out = _findings("""
+        def corrupt(join, proj, e):
+            join.left_keys[0] = e
+            proj.exprs.append(e)
+    """)
+    assert [f.rule for f in out] == ["ENG001", "ENG001"]
+
+
+def test_allows_locally_constructed_builders():
+    # builder-style initialization of a node the function provably owns
+    out = _findings("""
+        def build(child, exprs):
+            p = ProjectNode(child, [])
+            p.exprs = exprs
+            return p
+    """)
+    assert out == []
+
+
+def test_allows_unrelated_self_attributes():
+    # Planner-style classes own attributes that share plan-field names
+    out = _findings("""
+        class Planner:
+            def __init__(self):
+                self.cte_segments = []
+                self.keys = {}
+    """)
+    assert out == []
+
+
+def test_flags_self_writes_inside_ir_classes():
+    out = _findings("""
+        class ProjectNode:
+            def grow(self, e):
+                self.exprs = self.exprs + [e]
+    """)
+    assert [f.rule for f in out] == ["ENG001"]
+
+
+def test_frozen_pragma_exempts():
+    out = _findings("""
+        def annotate(root, segs):
+            root.cte_segments = segs  # lint: frozen-exempt (root annotation)
+    """)
+    assert out == []
+
+
+# -- ENG002: unlocked cross-thread writes -----------------------------------
+
+def test_flags_unlocked_cross_thread_write():
+    out = _findings("""
+        import threading
+
+        class Streamer:
+            def start(self):
+                t = threading.Thread(target=self._work)
+                t.start()
+
+            def _work(self):
+                self.progress = 1
+    """)
+    assert [f.rule for f in out] == ["ENG002"]
+    assert "progress" in out[0].message
+
+
+def test_lock_protected_write_allowed():
+    out = _findings("""
+        import threading
+
+        class Streamer:
+            def start(self):
+                t = threading.Thread(target=self._work)
+                t.start()
+
+            def _work(self):
+                with self._lock:
+                    self.progress = 1
+    """)
+    assert out == []
+
+
+def test_thread_local_objects_allowed():
+    out = _findings("""
+        from concurrent.futures import ThreadPoolExecutor
+
+        def launch(pool, items):
+            pool.map(worker, items)
+
+        def worker(item):
+            acc = Accumulator()
+            acc.total = 0       # thread-local, not shared state
+            return acc
+    """)
+    assert out == []
+
+
+def test_pool_submit_target_detected():
+    out = _findings("""
+        def launch(pool, shared):
+            pool.submit(worker, shared)
+
+        def worker(shared):
+            shared.count = 1
+    """)
+    assert [f.rule for f in out] == ["ENG002"]
+
+
+def test_lock_exempt_pragma():
+    out = _findings("""
+        import threading
+
+        def launch(state):
+            threading.Thread(target=work).start()
+
+        def work(state):
+            state.flag = True  # lint: lock-exempt (write-once sentinel)
+    """)
+    assert out == []
+
+
+# -- the CI gate: the real tree is clean ------------------------------------
+
+def test_nds_tpu_tree_is_clean():
+    findings = LINT.lint_paths([os.path.join(_REPO, "nds_tpu")])
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_cli_exit_codes(tmp_path):
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text("def f(n):\n    n.out_dtypes = []\n")
+    assert LINT.main([str(clean)]) == 0
+    assert LINT.main([str(dirty)]) == 1
+    assert LINT.main([]) == 2

@@ -1,0 +1,323 @@
+"""Static plan-IR verification (engine/verify.py + planner.PassPipeline).
+
+Three pillars, matching the reason the verifier exists (two of the last
+three rounds shipped fixes for bugs rewrite passes introduced silently):
+
+1. the full template sweep: every bundled query template plans under
+   ``verify_plans="per-pass"`` — every rewrite pass output checked, shared
+   nodes freeze-checked, parameter hoisting round-tripped — with ZERO
+   findings, in both decimal modes;
+2. mutation tests: seeded plan corruptions (dangling column index, dtype
+   mismatch, in-place mutation of a node) are caught, naming the RIGHT
+   node and the RIGHT pass;
+3. the compiled-query argument contract: ArgSpecMismatch reports
+   expected-vs-got dtype/shape PER ARGUMENT instead of a bare mismatch.
+"""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from nds_tpu import streams
+from nds_tpu.config import EngineConfig
+from nds_tpu.engine import plan as P
+from nds_tpu.engine.arrow_bridge import engine_schema
+from nds_tpu.engine.planner import (Catalog, PassPipeline, PlanError,
+                                    Planner)
+from nds_tpu.engine.verify import (PlanVerifyError, check_frozen,
+                                   node_labels, plan_fingerprint, snapshot,
+                                   verify_plan)
+from nds_tpu.power import strip_sql_comments
+from nds_tpu.schema import UNIQUE_KEYS, get_schemas
+from nds_tpu.sql import parse_sql
+
+# SF100-ish row counts so size-gated rewrites (late materialization) fire
+# during the sweep — the passes must be EXERCISED to be verified
+_FACT_ROWS = {
+    "store_sales": 288_000_000, "store_returns": 28_800_000,
+    "catalog_sales": 144_000_000, "catalog_returns": 14_400_000,
+    "web_sales": 72_000_000, "web_returns": 7_200_000,
+    "inventory": 399_330_000, "customer": 2_000_000,
+    "customer_demographics": 1_920_800, "item": 204_000,
+}
+
+
+def _catalog(dec_enabled: bool, verify: str = "per-pass") -> Catalog:
+    tables = {}
+    for name, sch in get_schemas(use_decimal=True).items():
+        names, dtypes = engine_schema(sch.arrow_schema(use_decimal=True),
+                                      dec_enabled)
+        tables[name] = (names, dtypes, _FACT_ROWS.get(name, 10_000))
+    uniq = {t: tuple(c for c in cols if c in tables[t][0])
+            for t, cols in UNIQUE_KEYS.items() if t in tables}
+    return Catalog(tables, dec_enabled=dec_enabled, unique_cols=uniq,
+                   verify_plans=verify)
+
+
+@pytest.fixture(scope="module")
+def catalogs():
+    return {dec: _catalog(dec) for dec in (False, True)}
+
+
+def _statements(number: int):
+    sql = streams.instantiate(number, stream=0, rngseed=31415)
+    parts = (streams.split_special_query(f"query{number}", sql)
+             if number in streams.SPECIAL_TEMPLATES
+             else [(f"query{number}", sql)])
+    for name, part_sql in parts:
+        for stmt in strip_sql_comments(part_sql).split(";"):
+            if stmt.strip():
+                yield name, stmt
+
+
+# -- 1. the sweep: every template, per-pass verification, zero findings ----
+
+@pytest.mark.parametrize("number", streams.available_templates())
+def test_template_sweep_per_pass(catalogs, number):
+    for dec in (False, True):
+        for name, stmt in _statements(number):
+            # PassPipeline raises PlanVerifyError on any finding
+            Planner(catalogs[dec]).plan_query(parse_sql(stmt))
+
+
+def test_verification_is_pure():
+    """per-pass verification must not alter the produced plan."""
+    sql = ("SELECT ss_store_sk, SUM(ss_quantity) q FROM store_sales "
+           "WHERE ss_quantity > 5 GROUP BY ss_store_sk ORDER BY q LIMIT 7")
+    verified = Planner(_catalog(False)).plan_query(parse_sql(sql))
+    plain = Planner(_catalog(False, verify="off")).plan_query(parse_sql(sql))
+    assert plan_fingerprint(verified) == plan_fingerprint(plain)
+
+
+def test_unknown_mode_rejected():
+    with pytest.raises(PlanError, match="verify_plans"):
+        PassPipeline("sometimes")
+
+
+# -- 2. mutation tests: corruption caught with node + pass attribution ----
+
+def _simple_plan(verify="off"):
+    cat = _catalog(False, verify=verify)
+    plan = Planner(cat).plan_query(parse_sql(
+        "SELECT ss_store_sk, SUM(ss_quantity) q FROM store_sales "
+        "WHERE ss_quantity > 5 GROUP BY ss_store_sk"))
+    return cat, plan
+
+
+def test_dangling_col_index_names_the_node():
+    cat, plan = _simple_plan()
+    labels = node_labels(plan)
+    proj = next(n for n in P.iter_plan_nodes(plan)
+                if isinstance(n, P.ProjectNode))
+    old = proj.exprs[0]
+    proj.exprs[0] = P.BCol(old.dtype, 999, old.name)
+    findings = verify_plan(plan, cat)
+    assert findings, "dangling index not caught"
+    assert any(f.kind == "colref" and f.label == labels[id(proj)]
+               and "999" in f.message for f in findings), \
+        [str(f) for f in findings]
+
+
+def test_dtype_mismatch_names_the_node():
+    cat, plan = _simple_plan()
+    labels = node_labels(plan)
+    proj = next(n for n in P.iter_plan_nodes(plan)
+                if isinstance(n, P.ProjectNode))
+    old = proj.exprs[0]
+    proj.exprs[0] = P.BCol("str", old.index, old.name)
+    findings = verify_plan(plan, cat)
+    assert any(f.kind == "dtype" and f.label == labels[id(proj)]
+               for f in findings), [str(f) for f in findings]
+
+
+def test_join_key_dtype_mismatch_caught():
+    cat = _catalog(False, verify="off")
+    plan = Planner(cat).plan_query(parse_sql(
+        "SELECT s_store_name, COUNT(*) FROM store_sales, store "
+        "WHERE ss_store_sk = s_store_sk GROUP BY s_store_name"))
+    join = next(n for n in P.iter_plan_nodes(plan)
+                if isinstance(n, P.JoinNode))
+    k = join.right_keys[0]
+    join.right_keys[0] = P.BCall("float", "cast", [k])
+    findings = verify_plan(plan, cat)
+    assert any(f.kind == "joinkey" and "int" in f.message
+               and "float" in f.message for f in findings), \
+        [str(f) for f in findings]
+
+
+def test_shared_node_mutation_names_node_and_pass():
+    """An in-place widening (the `_exact_rational_keys` hazard class) is
+    caught by the freeze check and attributed to the mutating pass."""
+    cat, plan = _simple_plan()
+    pipe = PassPipeline("per-pass", cat)
+    scan = next(n for n in P.iter_plan_nodes(plan)
+                if isinstance(n, P.ScanNode))
+    label = node_labels(plan)[id(scan)]
+
+    def benign(p):
+        return p
+
+    def evil(p):
+        scan.columns.append("ss_item_sk")
+        scan.out_names.append("ss_item_sk")
+        scan.out_dtypes.append("int")
+        return p
+
+    plan = pipe.run("benign_pass", benign, plan)
+    with pytest.raises(PlanVerifyError) as exc:
+        pipe.run("evil_widen", evil, plan)
+    assert exc.value.pass_name == "evil_widen"
+    assert any(f.kind == "frozen" and f.label == label
+               for f in exc.value.findings), \
+        [str(f) for f in exc.value.findings]
+    # and the message names both the node and the pass
+    assert "evil_widen" in str(exc.value) and label in str(exc.value)
+
+
+def test_bind_pass_attribution():
+    """A corruption present in the freshly bound plan is attributed to the
+    'bind' pass, not to a later rewrite."""
+    cat, plan = _simple_plan()
+    flt = next(n for n in P.iter_plan_nodes(plan)
+               if isinstance(n, P.FilterNode))
+    flt.predicate.dtype = "int"     # break bool-typed predicate invariant
+    pipe = PassPipeline("per-pass", cat)
+    with pytest.raises(PlanVerifyError) as exc:
+        pipe.check("bind", plan)
+    assert exc.value.pass_name == "bind"
+
+
+def test_check_frozen_reports_deepest_node():
+    cat, plan = _simple_plan()
+    before = snapshot(plan)
+    scan = next(n for n in P.iter_plan_nodes(plan)
+                if isinstance(n, P.ScanNode))
+    scan.out_names[0] = "renamed"
+    findings = check_frozen(plan, before)
+    # the scan mutated; its ancestors' fingerprints changed too, but only
+    # the deepest node is named
+    assert len(findings) == 1 and findings[0].node is scan
+
+
+def test_param_roundtrip_verified_deep():
+    """deep verification parameterizes + deparameterizes the plan and
+    proves structural identity (a literal-heavy template exercises it)."""
+    cat = _catalog(False, verify="off")
+    for name, stmt in _statements(3):
+        plan = Planner(cat).plan_query(parse_sql(stmt))
+        assert verify_plan(plan, cat, deep=True) == []
+
+
+def test_mergeable_agg_decomposition_checked():
+    cat, plan = _simple_plan()
+    agg = next(n for n in P.iter_plan_nodes(plan)
+               if isinstance(n, P.AggregateNode))
+    # corrupt the aggregate's declared output dtype: the streaming
+    # decomposition can no longer rebuild the declared schema
+    agg.out_dtypes[-1] = "str"
+    findings = verify_plan(plan, cat)
+    assert any(f.kind in ("agg", "dtype") for f in findings), \
+        [str(f) for f in findings]
+
+
+def test_stream_fusion_groups_verified():
+    """Fused shared-scan partial plans are plan-IR rewrites outside the
+    PassPipeline; streaming.verify_groups covers them."""
+    from nds_tpu.engine.streaming import MORSEL_TABLE, ScanGroup, \
+        verify_groups
+    scan = P.ScanNode(MORSEL_TABLE, ["a"], out_names=["a"],
+                      out_dtypes=["int"])
+    ok = P.ProjectNode(scan, [P.BCol("int", 0, "a")],
+                       out_names=["a"], out_dtypes=["int"])
+    verify_groups([ScanGroup("t", ["a"], ["int"], [(0, 0)], [ok])])
+    bad = P.ProjectNode(scan, [P.BCol("int", 99, "a")],
+                        out_names=["a"], out_dtypes=["int"])
+    with pytest.raises(PlanVerifyError, match="stream_fusion"):
+        verify_groups([ScanGroup("t", ["a"], ["int"], [(0, 0)], [bad])])
+
+
+# -- 3. config / session plumbing -----------------------------------------
+
+def test_property_file_and_config(tmp_path):
+    p = tmp_path / "props.conf"
+    p.write_text("nds.tpu.verify_plans=per-pass\n")
+    cfg = EngineConfig.from_property_file(str(p))
+    assert cfg.verify_plans == "per-pass"
+    assert EngineConfig().verify_plans in ("off", "final", "per-pass")
+
+
+def test_session_verifies_plans():
+    from nds_tpu.engine import Session
+    rng = np.random.default_rng(7)
+    n = 500
+    cfg = EngineConfig(verify_plans="per-pass", use_jax=False)
+    s = Session(cfg)
+    s.register_arrow("fact", pa.table({
+        "fk": pa.array(rng.integers(0, 20, n), type=pa.int64()),
+        "qty": pa.array(rng.integers(1, 50, n), type=pa.int64()),
+    }))
+    out = s.sql("SELECT fk, SUM(qty) FROM fact GROUP BY fk ORDER BY fk")
+    assert out.num_rows == 20
+    assert s._catalog().verify_plans == "per-pass"
+
+
+def test_power_flag_wired():
+    import nds_tpu.power as power
+    # argparse rejects values outside the off/final/per-pass tri-state
+    with pytest.raises(SystemExit):
+        power.main(["d", "s", "t", "--verify_plans", "sometimes"])
+
+
+# -- 4. compiled-query argument contract ----------------------------------
+
+def _compiled_session():
+    from nds_tpu.engine import Session
+    rng = np.random.default_rng(11)
+    n = 3000
+    s = Session(EngineConfig())
+    s.register_arrow("fact", pa.table({
+        "fk": pa.array(rng.integers(0, 40, n), type=pa.int64()),
+        "qty": pa.array(rng.integers(1, 100, n), type=pa.int64()),
+    }))
+    return s
+
+
+def test_compiled_query_arg_validation_reports_per_argument():
+    from nds_tpu.engine.jax_backend.executor import ArgSpecMismatch
+    s = _compiled_session()
+    sql = "SELECT fk, SUM(qty) FROM fact WHERE qty > 3 GROUP BY fk"
+    expected = sorted(map(tuple, s.sql(sql, backend="numpy").to_pylist()))
+    got = sorted(map(tuple, s.sql(sql, backend="jax").to_pylist()))
+    assert got == expected
+    jexec = s._jax_executor()
+    res = jexec.precompile_parallel()
+    key = ("sql", sql)
+    ent = jexec._plans.get(key) or jexec._plans.get((key, "root"))
+    assert ent is not None and ent.get("cq") is not None, res
+    cq = ent["cq"]
+    scans = jexec._scans_for(ent)
+    values = ent.get("params", ())
+
+    # well-formed args validate clean
+    cq.validate_args(scans, values)
+
+    # a missing scan names the absent key and the full contract
+    with pytest.raises(ArgSpecMismatch, match="missing scan"):
+        cq.validate_args({}, values)
+
+    # a short parameter vector reports expected dtypes vs got count
+    if cq.param_dtypes:
+        with pytest.raises(ArgSpecMismatch,
+                           match="parameter vector length"):
+            cq.validate_args(scans, ())
+
+    # a corrupted scan produces a per-argument expected-vs-got report
+    import jax
+    bad_key = cq.scan_keys[0]
+    bad = dict(scans)
+    bad[bad_key] = jax.tree_util.tree_map(
+        lambda x: x[:1] if getattr(x, "ndim", 0) >= 1 else x,
+        scans[bad_key])
+    with pytest.raises(ArgSpecMismatch) as exc:
+        cq.validate_args(bad, values)
+    msg = str(exc.value)
+    assert "expected" in msg and "got" in msg and repr(bad_key) in msg

@@ -30,6 +30,15 @@ import subprocess
 import sys
 import time
 
+# XLA:CPU AOT results deserialized from a persistent cache written on a
+# DIFFERENT machine spam a multi-KB machine-feature-mismatch warning per
+# load (cpu_aot_loader.cc), burying the bench output. Two-part fix, set
+# BEFORE jax/XLA load: scope the compile cache per host feature set (see
+# _host_cache_tag) so mismatched AOT entries are never loaded, and default
+# the C++ log level to errors-only so residual loader chatter stays out of
+# the JSON tail (export TF_CPP_MIN_LOG_LEVEL=0 to re-enable).
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+
 REPO = os.path.dirname(os.path.abspath(__file__))
 BENCH_DIR = os.environ.get("NDS_TPU_BENCH_DIR",
                            os.path.join(REPO, ".bench_data"))
@@ -72,9 +81,30 @@ def ensure_data() -> tuple[str, str]:
     return wh_dir, os.path.join(stream_dir, "query_0.sql")
 
 
+def _host_cache_tag() -> str:
+    """Stable per-host tag for the CPU compile-cache directory: caches from
+    hosts with different CPU feature sets never mix, so the XLA:CPU AOT
+    loader never sees (and never warns about) foreign-machine binaries."""
+    import hashlib
+    import platform
+
+    probe = f"{platform.machine()}|{platform.processor()}"
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    probe += "|" + " ".join(sorted(line.split()[2:]))
+                    break
+    except OSError:
+        pass
+    return hashlib.sha1(probe.encode()).hexdigest()[:10]
+
+
 def main() -> None:
     from nds_tpu.config import EngineConfig, enable_compile_cache, enable_x64
-    enable_compile_cache()
+    enable_compile_cache(os.path.join(
+        os.path.expanduser("~"), ".cache",
+        f"nds_tpu_xla_{_host_cache_tag()}"))
 
     from nds_tpu.engine import Session
     from nds_tpu.power import gen_sql_from_stream, setup_tables
@@ -86,7 +116,17 @@ def main() -> None:
     decimal = os.environ.get("NDS_TPU_BENCH_DECIMAL", "i64")
     if decimal == "i64":
         enable_x64()
-    session = Session(EngineConfig(decimal_physical=decimal))
+    config = EngineConfig(decimal_physical=decimal)
+    # A/B knobs for the upload-volume acceptance runs: NDS_TPU_BENCH_NARROW
+    # =0 restores the wide int64 morsel layout, NDS_TPU_BENCH_OOC_MIN_ROWS
+    # lowers the streaming threshold so the small bench slice streams
+    # (bytes_uploaded is 0 for device-resident in-core queries)
+    config.narrow_lanes = os.environ.get(
+        "NDS_TPU_BENCH_NARROW", "1").lower() not in ("0", "false", "no")
+    ooc_min = os.environ.get("NDS_TPU_BENCH_OOC_MIN_ROWS")
+    if ooc_min:
+        config.out_of_core_min_rows = int(ooc_min)
+    session = Session(config)
     setup_tables(session, wh_dir, "parquet")
     with open(stream_path) as f:
         query_dict = gen_sql_from_stream(f.read())
@@ -100,6 +140,8 @@ def main() -> None:
     jax_ms: dict[str, float] = {}
     np_ms: dict[str, float] = {}
     upload_bytes: dict[str, int] = {}
+    exec_modes: dict[str, str] = {}
+    fallback_reasons: dict[str, list] = {}
     for name in units:
         sql = query_dict[name]
         # untimed oracle warm run: the first execution pays the lazy parquet
@@ -117,8 +159,12 @@ def main() -> None:
         session.sql(sql, backend="jax")   # record (host) pass
         session.sql(sql, backend="jax")   # compile + first device run
         if session.last_fallbacks:
-            print(f"FATAL: {name} fell back to host: "
-                  f"{session.last_fallbacks}", file=sys.stderr)
+            # the per-operator REASON (last_exec_stats.fallback_reasons)
+            # makes the remaining host-bound queries enumerable per run
+            reasons = session.last_exec_stats.get(
+                "fallback_reasons", session.last_fallbacks)
+            print(f"FATAL: {name} fell back to host: {reasons}",
+                  file=sys.stderr)
             sys.exit(1)
         best = float("inf")
         for _ in range(TIMED_RUNS):
@@ -129,8 +175,13 @@ def main() -> None:
         # streamed queries re-upload their morsels every run; in-core
         # queries upload nothing in steady state (device-resident scans)
         upload_bytes[name] = session.last_exec_stats.get("bytes_uploaded", 0)
+        exec_modes[name] = session.last_exec_stats.get("mode", "in-core")
+        if session.last_exec_stats.get("fallback_reasons"):
+            fallback_reasons[name] = \
+                list(session.last_exec_stats["fallback_reasons"])
         print(f"{name}: device {jax_ms[name]:.1f} ms, "
-              f"oracle {np_ms[name]:.1f} ms", file=sys.stderr)
+              f"oracle {np_ms[name]:.1f} ms, mode {exec_modes[name]}, "
+              f"upload {upload_bytes[name] / 1e6:.2f} MB", file=sys.stderr)
 
     total_jax = sum(jax_ms.values())
     total_np = sum(np_ms.values())
@@ -150,9 +201,14 @@ def main() -> None:
         "scan_gb": round(bytes_scanned / 1e9, 3),
         # per-run H2D upload volume (streamed morsel buffers, summed over
         # the timed subset): the cost shared-scan fusion divides by the
-        # branch count — 0 when every query runs in-core device-resident
+        # branch count (and narrow lanes divide again) — 0 when every
+        # query runs in-core device-resident
         "upload_gb": round(sum(upload_bytes.values()) / 1e9, 3),
         "roofline_frac": round(bytes_scanned / bw / device_s, 4),
+        # which queries stream vs run in-core, and why any fell back to
+        # the host — the per-run enumeration of non-device work
+        "exec_modes": exec_modes,
+        "fallback_reasons": fallback_reasons,
     }))
 
 

@@ -11,11 +11,12 @@
 #                (every bundled template through per-pass verification +
 #                seeded-corruption mutation tests, tests/test_plan_verify.py)
 #   planner    - planner/streaming tier-1: late-materialization legality/
-#                differential, capacity-ladder, and shared-scan morsel
-#                fusion tests (fast, CPU backend): these rewrites change
-#                plans/execution for every dimension-grouped aggregate and
+#                differential, capacity-ladder, shared-scan morsel fusion,
+#                and narrow-lane packed-upload tests (fast, CPU backend):
+#                these rewrites change plans/execution (and the physical
+#                upload layout) for every dimension-grouped aggregate and
 #                every streamed query, so their SQLite-oracle exactness
-#                gates run early and cheaply
+#                and bit-identity gates run early and cheaply
 #   test       - full pytest suite on an 8-virtual-device CPU mesh
 #   bench      - quick bench slice (SF 0.01) to catch perf regressions early
 #   all        - every stage in order
@@ -63,7 +64,7 @@ stage_static() {
 stage_planner() {
     (cd "$REPO" && python -m pytest tests/test_late_materialization.py \
         tests/test_capacity_ladder.py tests/test_shared_scan.py \
-        tests/test_streaming.py -q)
+        tests/test_streaming.py tests/test_narrow_lanes.py -q)
 }
 
 stage_test() {

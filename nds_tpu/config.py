@@ -62,6 +62,17 @@ class EngineConfig:
     # programs over the shared staged buffer (bounded compile time).
     # 0 = fuse unconditionally.
     stream_fusion_max_branches: int = 16
+    # narrow-lane packed uploads + encoded execution: streamed morsels pack
+    # each column at its minimal physical width (u8/u16/u32/i32 lanes chosen
+    # statically from per-table column min/max stats + bit-packed validity,
+    # device.plan_lanes/pack_table) instead of widening everything to int64,
+    # and columns whose range fits 32 bits execute on i32 device arrays —
+    # widening to 64-bit happens only at arithmetic/aggregation sites.
+    # 2-4x fewer uploaded bytes per morsel on NDS fact tables, compounding
+    # with shared-scan fusion. Property: nds.tpu.narrow_lanes; the power
+    # runner exposes --no_narrow_lanes restoring the wide int64 layout
+    # bit-identically for A/B runs.
+    narrow_lanes: bool = True
     # late materialization for join-heavy aggregates (planner.
     # _late_materialization): group by the dimension's surrogate join key and
     # gather dimension attributes AFTER aggregation instead of materializing

@@ -72,24 +72,28 @@ def from_arrow_column(arr, dec_as_int: bool = False) -> Column:
         valid = ~np.asarray(arr.is_null()) if null_count else None
         return Column(dtype, _decimal_to_scaled_i64(arr), valid)
     if dtype == "str":
+        # encode at most ONCE (already-dictionary arrays pass through), and
+        # null indices fill host-side — the old float-NaN round-trip turned
+        # every null-bearing code array into a f64 copy
         if not pa.types.is_dictionary(t):
             arr = arr.dictionary_encode()
-        codes = arr.indices.to_numpy(zero_copy_only=False)
-        codes = np.where(np.isnan(codes.astype(np.float64)), -1, codes) \
-            if codes.dtype.kind == "f" else codes
-        codes = codes.astype(np.int32)
+        codes = pc.fill_null(arr.indices, -1) \
+            .to_numpy(zero_copy_only=False).astype(np.int32)
         valid = None
         if null_count:
             valid = ~np.asarray(arr.is_null())
             codes = np.where(valid, codes, -1)
-        dictionary = np.asarray(arr.dictionary.to_pylist(), dtype=object)
+        # to_numpy over the value buffer, NOT to_pylist: a wide dictionary
+        # (100k+ distinct values) otherwise pays a Python-object loop per
+        # morsel/load
+        dictionary = arr.dictionary.to_numpy(zero_copy_only=False) \
+            .astype(object)
         return Column("str", codes, valid, dictionary)
     if dtype == "date":
         valid = ~np.asarray(arr.is_null()) if null_count else None
         ints = arr.cast(pa.int32())
         if null_count:  # fill BEFORE to_numpy: nulls otherwise round-trip
-            import pyarrow.compute as pc  # through float NaN -> int garbage
-            ints = pc.fill_null(ints, 0)
+            ints = pc.fill_null(ints, 0)  # through float NaN -> int garbage
         days = ints.to_numpy(zero_copy_only=False)
         return Column("date", np.asarray(days, dtype=np.int32), valid)
     if dtype == "float":
@@ -157,6 +161,86 @@ def to_arrow(table: Table) -> pa.Table:
     return pa.table(dict(zip(_dedupe(table.names), arrays))) \
         if len(set(table.names)) != len(table.names) else \
         pa.Table.from_arrays(arrays, names=table.names)
+
+
+# -- column value-range stats (narrow-lane planning) --------------------------
+# (lo, hi) per column in ENGINE units: raw ints for "int", epoch days for
+# "date", SCALED ints for decimals under decimal_physical="i64". Streaming
+# chooses per-column upload lanes from these ONCE per scan group, so morsel
+# widths are static per schedule (device.plan_lanes).
+
+def _stat_pair(t: pa.DataType, mn, mx, dec_as_int: bool):
+    """Convert an arrow min/max pair to engine units; None = no stats for
+    this column (it then rides the widest legal lane)."""
+    if mn is None or mx is None:
+        return None
+    if pa.types.is_integer(t):
+        return int(mn), int(mx)
+    if pa.types.is_date(t):
+        import datetime
+        epoch = datetime.date(1970, 1, 1)
+        return (mn - epoch).days, (mx - epoch).days
+    if pa.types.is_decimal(t) and dec_as_int:
+        return int(mn.scaleb(t.scale)), int(mx.scaleb(t.scale))
+    return None     # float/bool/str: lane is dtype-determined
+
+
+def table_column_stats(table: pa.Table, dec_as_int: bool = False) -> dict:
+    """{column: (lo, hi)} for the lane-relevant columns of an in-memory
+    arrow table (one vectorized min_max pass per column)."""
+    out: dict = {}
+    for name in table.column_names:
+        col = table.column(name)
+        t = col.type
+        if not (pa.types.is_integer(t) or pa.types.is_date(t)
+                or (pa.types.is_decimal(t) and dec_as_int)):
+            continue
+        mm = pc.min_max(col)
+        pair = _stat_pair(t, mm["min"].as_py(), mm["max"].as_py(),
+                          dec_as_int)
+        if pair is not None:
+            out[name] = pair
+    return out
+
+
+def parquet_column_stats(paths, dec_as_int: bool = False) -> dict:
+    """{column: (lo, hi)} aggregated over parquet files from row-group
+    METADATA only (no data read). A column missing statistics in any row
+    group of any file is omitted (unknown range -> widest lane)."""
+    import pyarrow.parquet as pq
+
+    agg: dict = {}
+    bad: set = set()
+    schema = None
+    for path in paths:
+        meta = pq.read_metadata(path)
+        if schema is None:
+            schema = pq.read_schema(path)
+        names = meta.schema.names
+        for rg in range(meta.num_row_groups):
+            group = meta.row_group(rg)
+            if group.num_rows == 0:
+                continue
+            for ci in range(group.num_columns):
+                name = names[ci]
+                if name in bad or name not in schema.names:
+                    continue
+                t = schema.field(name).type
+                if not (pa.types.is_integer(t) or pa.types.is_date(t)
+                        or (pa.types.is_decimal(t) and dec_as_int)):
+                    bad.add(name)
+                    continue
+                st = group.column(ci).statistics
+                pair = None if st is None or not st.has_min_max else \
+                    _stat_pair(t, st.min, st.max, dec_as_int)
+                if pair is None:
+                    bad.add(name)
+                    agg.pop(name, None)
+                    continue
+                old = agg.get(name)
+                agg[name] = pair if old is None else \
+                    (min(old[0], pair[0]), max(old[1], pair[1]))
+    return agg
 
 
 def _dedupe(names: list[str]) -> list[str]:

@@ -169,93 +169,316 @@ def to_device(table: Table, capacity: Optional[int] = None,
     return DTable(list(table.names), cols, put(alive))
 
 
+# -- narrow-lane packed layout ------------------------------------------------
+# Per-column physical lane on the tunnel wire. The device unpacks lazily
+# (slice + bitcast + widen fuse into the compiled program), so the wire
+# width and the device compute width are decoupled:
+#
+#   lane   wire bytes/row   device array      legal for
+#   "b1"   1/8 (bit-packed) bool              bool
+#   "u8"   1                int32             int, decN, date, str
+#   "u16"  2                int32             int, decN, date, str
+#   "u32"  4                int32             int, decN  (values < 2^31)
+#   "i32"  4                int32             int, decN, date, str
+#   "i64"  8                int64             int, decN       (x64 only)
+#   "f32"  4                float32 (bitcast) float           (no-x64 tier)
+#   "f64"  8                float64 (bitcast) float           (x64 only)
+#
+# Narrow unsigned lanes require non-negative values; every lane's value
+# bounds are in _LANE_BOUNDS and packing VERIFIES the data fits (a lane
+# too narrow for its column is a hard error, not silent truncation).
+# Unpack targets are always SIGNED (i32/i64) so downstream sort/compare/
+# negate kernels never meet unsigned wraparound; int columns whose range
+# fits 32 bits execute on i32 device arrays — on chips that emulate S64
+# as dual u32, filters/join keys/group keys over such columns run at half
+# the gather/sort cost ("encoded execution"). 64-bit widening happens
+# only at arithmetic/aggregation sites (see jexprs.widen_col callers).
+
+_LANE_WIRE = {"b1": 0, "u8": 1, "u16": 2, "u32": 4, "i32": 4,
+              "i64": 8, "f32": 4, "f64": 8}   # b1: special-cased, cap/8 B
+
+# inclusive [lo, hi] value bounds per integer lane. i32 excludes INT32_MIN:
+# descending sort negates key lanes in place and -INT32_MIN would wrap,
+# breaking on/off bit-identity for that (pathological) value.
+_LANE_BOUNDS = {
+    "u8": (0, (1 << 8) - 1),
+    "u16": (0, (1 << 16) - 1),
+    "u32": (0, (1 << 31) - 1),
+    "i32": (-(1 << 31) + 1, (1 << 31) - 1),
+    "i64": (-(1 << 63), (1 << 63) - 1),
+}
+
+_LANE_NP = {"u8": np.uint8, "u16": np.uint16, "u32": np.uint32,
+            "i32": np.int32, "i64": np.int64, "f32": np.float32,
+            "f64": np.float64}
+
+
+def lane_legal(lane: str, dtype: str) -> bool:
+    """May a column of logical `dtype` ride this lane at all? (Static
+    dtype-level legality; value-range legality is checked against stats by
+    the verifier and against the actual data by pack_table.)"""
+    if dtype == "float":
+        return lane in ("f32", "f64")   # f32 = the no-x64 physical tier
+    if dtype == "bool":
+        return lane == "b1"
+    if dtype in ("date", "str"):
+        return lane in ("u8", "u16", "i32")
+    if dtype == "int" or is_dec(dtype):
+        return lane in ("u8", "u16", "u32", "i32", "i64")
+    return False
+
+
+def _lane_rows_bytes(lane: str, cap: int) -> int:
+    if lane == "b1":
+        return (cap + 7) // 8
+    return _LANE_WIRE[lane] * cap
+
+
+def lane_bytes(lanes: tuple, cap: int) -> int:
+    """Total wire bytes of a packed table: per-column data sections plus
+    (ncols + 1) bit-packed validity sections (last = alive mask)."""
+    return sum(_lane_rows_bytes(ln, cap) for ln in lanes) + \
+        (len(lanes) + 1) * ((cap + 7) // 8)
+
+
+def _narrow_int_lane(lo: int, hi: int) -> str:
+    if lo >= 0:
+        for lane in ("u8", "u16", "u32"):
+            if hi <= _LANE_BOUNDS[lane][1]:
+                return lane
+    if lo >= _LANE_BOUNDS["i32"][0] and hi <= _LANE_BOUNDS["i32"][1]:
+        return "i32"
+    return "i64"
+
+
+def plan_lanes(dtypes: list, stats: Optional[list] = None,
+               dict_sizes: Optional[list] = None,
+               narrow: bool = True) -> Optional[tuple]:
+    """Choose a per-column lane spec from logical dtypes + optional value
+    stats. stats[i] is (min, max) in ENGINE units (scaled ints for decN,
+    epoch days for date) or None (unknown -> widest legal lane, always
+    safe); dict_sizes[i] is the dictionary cardinality for "str" columns.
+
+    narrow=False restores the legacy wide layout (int/dec wire int64,
+    date/str wire int32, floats f64; bool/str columns unpackable -> None,
+    the per-column to_device fallback; requires x64 like the old int64
+    carrier did) — the --no_narrow_lanes contract. Without x64, wide
+    integer/float tiers are i32/f32 (the physical dtypes that mode runs
+    anyway), so narrow packing works on the no-x64 tier too.
+
+    Returns None when some column cannot pack at all."""
+    x64 = jax.config.read("jax_enable_x64")
+    if not narrow and not x64:
+        return None
+    wide_int = "i64" if x64 else "i32"
+    lanes = []
+    for i, dt in enumerate(dtypes):
+        st = stats[i] if stats is not None else None
+        if dt == "float":
+            lanes.append("f64" if x64 else "f32")
+        elif dt == "bool":
+            if not narrow:
+                return None
+            lanes.append("b1")
+        elif dt == "str":
+            if not narrow:
+                return None
+            ds = dict_sizes[i] if dict_sizes is not None else None
+            if ds is None:
+                lanes.append("i32")
+            elif ds <= _LANE_BOUNDS["u8"][1] + 1:
+                lanes.append("u8")
+            elif ds <= _LANE_BOUNDS["u16"][1] + 1:
+                lanes.append("u16")
+            else:
+                lanes.append("i32")
+        elif dt == "date":
+            if not narrow or st is None:
+                lanes.append("i32")
+            else:
+                lo, hi = int(st[0]), int(st[1])
+                lane = _narrow_int_lane(lo, hi)
+                lanes.append(lane if lane in ("u8", "u16") else "i32")
+        elif dt == "int" or is_dec(dt):
+            if not narrow or st is None:
+                lanes.append(wide_int)
+            else:
+                lane = _narrow_int_lane(int(st[0]), int(st[1]))
+                # no-x64 tier: values fit 32 bits by config contract
+                lanes.append("i32" if lane == "i64" and not x64 else lane)
+        else:
+            return None
+    return tuple(lanes)
+
+
+class LaneOverflowError(ValueError):
+    """A column's values do not fit its declared lane (stats drift or a
+    rewrite bug) — surfaced loudly instead of wrapping silently."""
+
+
 @dataclass
 class PackedTable:
     """A columnar table packed for ONE-transfer upload through a tunneled
-    device link: all column payloads ride in a single (ncols, cap) int64
-    matrix (floats bit-cast, narrow ints widened) and all masks in one
-    (ncols+1, cap) bool matrix whose last row is the alive mask. Per-column
-    transfers cost a fixed RTT each on tunneled platforms — a streamed
-    morsel paid ~2*ncols RTTs per dispatch; packed it pays 2. Columns
-    unpack INSIDE the traced program (slice/bitcast fuse into the compiled
-    plan). Requires x64 (the i64 carrier) and no string columns (morsel
-    eligibility already excludes big-scan strings)."""
+    device link: every column payload and every validity mask rides in a
+    single contiguous uint8 buffer. Column sections use per-column narrow
+    lanes (see the lane table above); validity masks (plus the alive mask,
+    last) are bit-packed at 1 bit/row. Per-buffer transfers cost a fixed
+    RTT each on tunneled platforms — a streamed morsel paid ~2*ncols RTTs
+    per dispatch; packed it pays 1. Columns unpack INSIDE the traced
+    program as zero-copy views (slice/bitcast/unpackbits fuse into the
+    compiled plan). The lane spec is pytree aux_data, so compiled-program
+    cache keys include the physical layout and a lane change can never
+    replay a stale program. Requires x64 (i64/f64 lanes)."""
     names: list[str]
     dtypes: list[str]           # logical dtypes
-    modes: tuple                # per column: "i64" | "f64bits" | "i32"
-    data: jax.Array             # (ncols, cap) int64
-    masks: jax.Array            # (ncols + 1, cap) bool; last row = alive
+    lanes: tuple                # per-column lane tags, see _LANE_WIRE
+    cap: int                    # padded row capacity
+    data: jax.Array             # uint8[lane_bytes(lanes, cap)]
+    dictionaries: tuple = ()    # host dictionaries for "str" columns
 
     @property
     def capacity(self) -> int:
-        return int(self.masks.shape[1])
+        return self.cap
 
 
 def _packed_flatten(p: PackedTable):
-    return (p.data, p.masks), (tuple(p.names), tuple(p.dtypes), p.modes)
+    return (p.data,), (tuple(p.names), tuple(p.dtypes), p.lanes, p.cap,
+                       _ById(p.dictionaries))
 
 
 def _packed_unflatten(aux, children):
-    data, masks = children
-    return PackedTable(list(aux[0]), list(aux[1]), aux[2], data, masks)
+    return PackedTable(list(aux[0]), list(aux[1]), aux[2], aux[3],
+                       children[0], aux[4].obj)
 
 
 jax.tree_util.register_pytree_node(PackedTable, _packed_flatten,
                                    _packed_unflatten)
 
 
-def pack_table(table: Table, capacity: Optional[int] = None
-               ) -> Optional[PackedTable]:
-    """Host-side packing for upload; None if the table can't pack (strings,
-    or x32 mode where the i64 carrier is unavailable)."""
-    if not jax.config.read("jax_enable_x64"):
-        return None
-    # gate on every column BEFORE allocating the carrier (a mid-loop bail
-    # would waste the (ncols, cap) allocation per morsel on the fallback)
-    if any(c.dtype == "str" or np.dtype(phys_dtype(c.dtype)) not in
-           (np.dtype(np.int64), np.dtype(np.float64), np.dtype(np.int32))
-           for c in table.columns):
-        return None
+def pack_table(table: Table, capacity: Optional[int] = None,
+               lanes: Optional[tuple] = None) -> Optional[PackedTable]:
+    """Host-side packing for upload; None if the table can't pack under the
+    given lane spec (default: the legacy wide layout, which rejects
+    strings/bools exactly like the pre-lane int64 carrier did).
+
+    `lanes` is the STATIC per-column lane spec: streaming computes it once
+    per scan group from table-wide column stats and passes it for every
+    morsel, so morsel widths never drift mid-stream (a width change would
+    be a different compiled program). Values are VERIFIED against the lane
+    bounds — stats drift raises LaneOverflowError instead of wrapping."""
+    if lanes is None:
+        lanes = plan_lanes([c.dtype for c in table.columns], narrow=False)
+        if lanes is None:
+            return None
+    if not jax.config.read("jax_enable_x64") and \
+            any(ln in ("i64", "f64") for ln in lanes):
+        return None     # 64-bit lanes unrepresentable on the no-x64 tier
+    if len(lanes) != len(table.columns):
+        raise ValueError(f"{len(lanes)} lanes for {len(table.columns)} "
+                         "columns")
     n = table.num_rows
     cap = capacity if capacity is not None else bucket(n)
-    ncols = len(table.columns)
-    data = np.zeros((ncols, cap), dtype=np.int64)
-    masks = np.zeros((ncols + 1, cap), dtype=bool)
-    masks[ncols, :n] = True
-    modes = []
-    for i, c in enumerate(table.columns):
-        pd = np.dtype(phys_dtype(c.dtype))
-        buf = np.zeros(cap, dtype=pd)
-        buf[:n] = np.where(c.validity, np.asarray(c.data), 0)
-        if pd == np.float64:
-            data[i] = buf.view(np.int64)
-            modes.append("f64bits")
-        elif pd == np.int32:
-            data[i] = buf.astype(np.int64)
-            modes.append("i32")
+    parts: list[np.ndarray] = []
+    vparts: list[np.ndarray] = []
+    dicts = []
+    for ci, (c, lane) in enumerate(zip(table.columns, lanes)):
+        if not lane_legal(lane, c.dtype):
+            raise LaneOverflowError(
+                f"column {table.names[ci]!r}: lane {lane!r} illegal for "
+                f"dtype {c.dtype!r}")
+        v = c.validity
+        data = np.asarray(c.data)
+        if c.dtype == "str":
+            # canonical null slot for codes is 0 (valid=False marks them)
+            data = np.where(v & (data >= 0), data, 0)
+            dicts.append(c.dictionary)
         else:
-            data[i] = buf
-            modes.append("i64")
-        masks[i, :n] = c.validity
+            dicts.append(None)
+            data = np.where(v, data, np.zeros((), dtype=data.dtype))
+        if lane == "b1":
+            bits = np.zeros(cap, dtype=bool)
+            bits[:n] = data.astype(bool)
+            parts.append(np.packbits(bits, bitorder="little"))
+        else:
+            lo, hi = _LANE_BOUNDS.get(lane, (None, None))
+            if lo is not None and n and data.size:
+                dmin, dmax = int(data[:n].min()), int(data[:n].max())
+                if dmin < lo or dmax > hi:
+                    raise LaneOverflowError(
+                        f"column {table.names[ci]!r} values "
+                        f"[{dmin}, {dmax}] overflow lane {lane!r}")
+            buf = np.zeros(cap, dtype=_LANE_NP[lane])
+            buf[:n] = data
+            parts.append(buf.view(np.uint8))
+        vbits = np.zeros(cap, dtype=bool)
+        vbits[:n] = v
+        vparts.append(np.packbits(vbits, bitorder="little"))
+    alive = np.zeros(cap, dtype=bool)
+    alive[:n] = True
+    vparts.append(np.packbits(alive, bitorder="little"))
+    payload = np.concatenate(parts + vparts) if parts + vparts else \
+        np.zeros(0, dtype=np.uint8)
     return PackedTable(list(table.names), [c.dtype for c in table.columns],
-                       tuple(modes), jnp.asarray(data), jnp.asarray(masks))
+                       tuple(lanes), cap, jnp.asarray(payload), tuple(dicts))
+
+
+def _unpack_bits(seg: jax.Array, cap: int) -> jax.Array:
+    return jnp.unpackbits(seg, count=cap, bitorder="little").astype(bool)
+
+
+def _unpack_lane(seg: jax.Array, lane: str, cap: int) -> jax.Array:
+    """Bytes -> device array for one column (traced or concrete); narrow
+    unsigned lanes widen to SIGNED i32 so downstream kernels never meet
+    unsigned wraparound."""
+    from jax import lax
+
+    if lane == "b1":
+        return _unpack_bits(seg, cap)
+    if lane == "u8":
+        return seg.astype(jnp.int32)
+    width = _LANE_WIRE[lane]
+    carrier = {"u16": jnp.uint16, "u32": jnp.uint32, "i32": jnp.int32,
+               "i64": jnp.int64, "f32": jnp.float32,
+               "f64": jnp.float64}[lane]
+    out = lax.bitcast_convert_type(seg.reshape(cap, width), carrier)
+    if lane in ("u16", "u32"):
+        out = out.astype(jnp.int32)     # u32 bound is 2^31-1: no overflow
+    return out
 
 
 def unpack_table(p: PackedTable) -> DTable:
-    """Traced (or concrete) unpacking back into per-column device arrays."""
-    from jax import lax
-
+    """Traced (or concrete) unpacking back into per-column device arrays:
+    each column is a zero-copy byte-slice view of the single uploaded
+    buffer, bitcast to its lane carrier and widened to its signed device
+    dtype — all of which fuses into the consuming compiled program."""
+    vbytes = (p.cap + 7) // 8
     cols = []
-    for i, (dtype, mode) in enumerate(zip(p.dtypes, p.modes)):
-        row = p.data[i]
-        if mode == "f64bits":
-            d = lax.bitcast_convert_type(row, jnp.float64)
-        elif mode == "i32":
-            d = row.astype(jnp.int32)
-        else:
-            d = row
-        cols.append(DCol(dtype, d, p.masks[i]))
-    return DTable(list(p.names), cols, p.masks[len(p.dtypes)])
+    off = 0
+    voff = sum(_lane_rows_bytes(ln, p.cap) for ln in p.lanes)
+    dicts = p.dictionaries or (None,) * len(p.dtypes)
+    for dtype, lane, dc in zip(p.dtypes, p.lanes, dicts):
+        sz = _lane_rows_bytes(lane, p.cap)
+        d = _unpack_lane(p.data[off:off + sz], lane, p.cap)
+        valid = _unpack_bits(p.data[voff:voff + vbytes], p.cap)
+        cols.append(DCol(dtype, d, valid, dc))
+        off += sz
+        voff += vbytes
+    alive = _unpack_bits(p.data[voff:voff + vbytes], p.cap)
+    return DTable(list(p.names), cols, alive)
+
+
+def widen_col(c: DCol) -> DCol:
+    """Physical-width view of a column: a narrow-lane device array
+    (encoded execution) widens to the logical physical dtype. Callers are
+    the sites that genuinely need 64-bit arithmetic — aggregate/window
+    arguments and decimal rescaling — everything else (filters, join keys,
+    group keys, sorts) runs on the narrow encoding."""
+    if c.dtype in ("bool", "str", "date", "float"):
+        return c
+    pd = phys_dtype(c.dtype)
+    if c.data.dtype == pd or not jnp.issubdtype(c.data.dtype, jnp.integer):
+        return c
+    return replace(c, data=c.data.astype(pd))
 
 
 def device_bytes(dt: "Optional[DTable | PackedTable]") -> int:

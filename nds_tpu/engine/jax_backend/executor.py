@@ -41,7 +41,7 @@ from ..plan import (
 from . import jexprs, kernels
 from .device import (DCol, DTable, PackedTable, bucket, free_dtable,
                      phys_dtype, rank_key, string_rank_lut, to_device,
-                     to_host, unpack_table)
+                     to_host, unpack_table, widen_col)
 
 _I32 = jnp.int32
 
@@ -1416,7 +1416,8 @@ class JaxExecutor:
         pcols = [self._eval(e, child) for e in wf.partition_by]
         gid, _ = self._dense_rank([rank_key(c) for c in pcols],
                                   [c.valid for c in pcols], child.alive)
-        arg_col = None if wf.arg is None else self._eval(wf.arg, child)
+        arg_col = None if wf.arg is None else widen_col(
+            self._eval(wf.arg, child))
         if arg_col is not None and arg_col.dtype == "str":
             raise NotImplementedError("window function over strings (device)")
         func = wf.func
@@ -1500,8 +1501,11 @@ class JaxExecutor:
         group_cols = [self._eval(e, child) for e in node.group_exprs]
         keys = [rank_key(c) for c in group_cols]
         kvalids = [c.valid for c in group_cols]
-        arg_cols = [None if s.arg is None else self._eval(s.arg, child)
-                    for s in node.aggs]
+        # aggregate arguments widen off narrow lanes: the within-group scan
+        # accumulates in the payload dtype, and an i32 sum over a morsel of
+        # narrow-lane values would overflow (group KEYS stay narrow)
+        arg_cols = [None if s.arg is None else widen_col(
+            self._eval(s.arg, child)) for s in node.aggs]
         x64 = jax.config.read("jax_enable_x64")
         fd = jnp.float64 if x64 else jnp.float32
 
@@ -1705,8 +1709,9 @@ class JaxExecutor:
         replicated merge re-ranks 8*n_partial candidate groups. GSPMD's
         fallback for the same plan all-gathers the whole child (measured:
         q3-class group-by gathered cap-sized s32 buffers)."""
-        from jax import shard_map
         from jax.sharding import PartitionSpec
+
+        from ...parallel.dist_ops import shard_map
         from .device import string_rank_maps
 
         mesh = self._mesh
@@ -1728,7 +1733,7 @@ class JaxExecutor:
                 spec_args.append(None)
                 recipes.append(("count_star", None))
                 continue
-            ac = self._eval(spec.arg, child)
+            ac = widen_col(self._eval(spec.arg, child))
             post = None
             data, valid = ac.canon().data, ac.valid
             if ac.dtype == "str":
@@ -1950,7 +1955,8 @@ class JaxExecutor:
                       cap_out: int) -> list[DCol]:
         out: list[DCol] = []
         for spec in specs:
-            arg_col = None if spec.arg is None else self._eval(spec.arg, child)
+            arg_col = None if spec.arg is None else widen_col(
+                self._eval(spec.arg, child))
             use_alive = alive
             if spec.distinct and arg_col is not None:
                 use_alive = kernels.distinct_within_group(

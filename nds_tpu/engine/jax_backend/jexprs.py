@@ -20,7 +20,7 @@ import numpy as np
 
 from ..column import dec_scale, is_dec
 from ..plan import BCall, BCol, BExpr, BLit, BParam, BScalarSubquery
-from .device import DCol, DTable, phys_dtype, string_rank_lut
+from .device import DCol, DTable, phys_dtype, string_rank_lut, widen_col
 
 SubqueryEval = Callable[[object], object]
 
@@ -172,7 +172,7 @@ def _arith(op: str):
 
 
 def _neg(expr: BCall, table: DTable, sq) -> DCol:
-    a = evaluate(expr.args[0], table, sq)
+    a = widen_col(evaluate(expr.args[0], table, sq))
     return DCol(a.dtype, -a.data, a.valid)
 
 
@@ -298,7 +298,10 @@ def _in_list(expr: BCall, table: DTable, sq) -> DCol:
     elif is_dec(a.dtype):
         from ..exprs import _scaled_in_values
         vals = _scaled_in_values(values, dec_scale(a.dtype))
-        out = jnp.isin(a.data, jnp.asarray(vals, a.data.dtype)) if vals \
+        # membership at PHYSICAL width: scaled values cast down to a narrow
+        # lane dtype would wrap and alias unrelated rows
+        pd = phys_dtype(a.dtype)
+        out = jnp.isin(a.data.astype(pd), jnp.asarray(vals, pd)) if vals \
             else jnp.zeros(a.data.shape, bool)
     else:
         vals = [v for v in values if v is not None]
@@ -408,7 +411,9 @@ def _halfup_rescale(data: jax.Array, from_scale: int,
 
 
 def _cast(expr: BCall, table: DTable, sq) -> DCol:
-    a = evaluate(expr.args[0], table, sq)
+    # rescaling (decN targets/sources) multiplies by 10^k: widen narrow
+    # lanes up front so the scale arithmetic runs at physical width
+    a = widen_col(evaluate(expr.args[0], table, sq))
     target = expr.dtype
     if target == a.dtype:
         return a
@@ -543,12 +548,12 @@ def _concat(expr: BCall, table: DTable, sq) -> DCol:
 
 
 def _abs(expr: BCall, table: DTable, sq) -> DCol:
-    a = evaluate(expr.args[0], table, sq)
+    a = widen_col(evaluate(expr.args[0], table, sq))
     return DCol(a.dtype, jnp.abs(a.data), a.valid)
 
 
 def _round(expr: BCall, table: DTable, sq) -> DCol:
-    a = evaluate(expr.args[0], table, sq)
+    a = widen_col(evaluate(expr.args[0], table, sq))
     digits = expr.extra if expr.extra is not None else 0
     if is_dec(a.dtype) and is_dec(expr.dtype):
         # negative digits: round to tens/hundreds, then restore scale 0

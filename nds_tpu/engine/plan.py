@@ -110,6 +110,11 @@ class PlanNode:
 class ScanNode(PlanNode):
     table: str
     columns: list[str]  # physical columns to read, in output order
+    # per-column physical upload lane (device.plan_lanes tags) for packed
+    # morsel scans; None = layout decided by the executor (in-core scans).
+    # Width metadata so the plan verifier can prove every lane wide enough
+    # for its column's value range BEFORE a morsel ships on it.
+    lanes: Optional[tuple] = None
 
 
 @dataclass

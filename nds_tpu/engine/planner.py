@@ -173,6 +173,19 @@ class Catalog:
     # static plan-IR verification mode (EngineConfig.verify_plans mirror):
     # off | final | per-pass — see PassPipeline / engine/verify.py
     verify_plans: str = "off"
+    # callable(table) -> {column: (lo, hi)} value-range stats in engine
+    # units (None = no stats source). The verifier proves declared narrow
+    # upload lanes (ScanNode.lanes) wide enough for the recorded ranges;
+    # streaming chooses the lanes from the same source (Session.column_stats)
+    stats_source: object = None
+
+    def col_stats(self, name: str) -> dict:
+        if self.stats_source is None:
+            return {}
+        try:
+            return self.stats_source(name) or {}
+        except Exception:
+            return {}
 
     def schema(self, name: str) -> tuple[list[str], list[str]]:
         if name not in self.tables:

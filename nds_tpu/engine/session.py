@@ -21,6 +21,23 @@ from .planner import Catalog, Planner
 from . import arrow_bridge
 
 
+def _engine_table_stats(t: Table) -> dict:
+    """{column: (lo, hi)} for an already-materialized engine Table (view
+    registrations): engine units by construction."""
+    import numpy as np
+
+    from .column import is_dec
+
+    out: dict = {}
+    for name, c in zip(t.names, t.columns):
+        if not (c.dtype in ("int", "date") or is_dec(c.dtype)):
+            continue
+        data = np.asarray(c.data)[c.validity]
+        if data.size:
+            out[name] = (int(data.min()), int(data.max()))
+    return out
+
+
 def _and_conjuncts(node):
     """Top-level AND conjuncts of a WHERE AST (shared by the partition and
     file-stats delete pruners)."""
@@ -52,6 +69,11 @@ class Session:
         # optional streaming readers for out-of-core scans: name ->
         # fn(columns) yielding arrow tables/batches
         self._batch_sources: dict = {}
+        # per-table column value-range stats for narrow-lane planning:
+        # name -> callable() -> {column: (lo, hi) in engine units}, lazily
+        # evaluated and cached (column_stats); registration/drop invalidates
+        self._stats_sources: dict = {}
+        self._col_stats: dict[str, dict] = {}
         # device-backend fallback observability, reset per sql() call
         self.last_fallbacks: list[str] = []
         # execution-mode/timing observability for the last sql() call
@@ -144,6 +166,8 @@ class Session:
         def batches(columns, t=table):
             yield t.select(list(columns)) if columns else t
         self._batch_sources[name] = batches
+        self._stats_sources[name] = \
+            lambda t=table, dec=dec: arrow_bridge.table_column_stats(t, dec)
         self._drop_cached(name)
         self._generation += 1
 
@@ -171,6 +195,11 @@ class Session:
             cols = list(columns) if columns is not None else None
             yield from ds.to_batches(columns=cols)
         self._batch_sources[name] = batches
+        # parquet row-group METADATA carries per-column min/max: lane
+        # planning costs one metadata pass, no data read
+        self._stats_sources[name] = \
+            lambda ds=dataset, dec=dec: arrow_bridge.parquet_column_stats(
+                list(ds.files), dec)
         self._drop_cached(name)
         self._generation += 1
 
@@ -231,6 +260,7 @@ class Session:
         self._est_rows[name] = table.num_rows
         self._loaders[name] = lambda columns=None, t=table: \
             t if columns is None else t.select(list(columns))
+        self._stats_sources[name] = lambda t=table: _engine_table_stats(t)
         self._drop_cached(name)
         self._cache[(name, None)] = table
         self._generation += 1
@@ -239,6 +269,7 @@ class Session:
         self._schemas.pop(name, None)
         self._loaders.pop(name, None)
         self._batch_sources.pop(name, None)
+        self._stats_sources.pop(name, None)
         self._drop_cached(name)
         self._est_rows.pop(name, None)
         self._unique_cols.pop(name, None)
@@ -250,6 +281,26 @@ class Session:
     def _drop_cached(self, name: str) -> None:
         for k in [k for k in self._cache if k[0] == name]:
             del self._cache[k]
+        self._col_stats.pop(name, None)
+
+    def column_stats(self, name: str) -> dict:
+        """{column: (lo, hi)} value-range stats in ENGINE units (scaled
+        ints for decimals, epoch days for dates) for a registered table;
+        {} when the registration has no stats source. Lazily computed and
+        cached per registration generation — streaming derives the static
+        per-column upload lane spec from these (device.plan_lanes), and the
+        plan verifier proves declared lanes against the same ranges."""
+        if name in self._col_stats:
+            return self._col_stats[name]
+        src = self._stats_sources.get(name)
+        stats = {}
+        if src is not None:
+            try:
+                stats = src() or {}
+            except Exception:
+                stats = {}      # stats are an optimization, never a failure
+        self._col_stats[name] = stats
+        return stats
 
     def iter_morsels(self, name: str, columns: list[str], rows: int):
         """Yield host Tables of at most `rows` rows each, WITHOUT
@@ -317,7 +368,8 @@ class Session:
                        unique_cols=dict(self._unique_cols),
                        late_mat=self.config.late_materialization,
                        late_mat_min_rows=self.config.late_mat_min_rows,
-                       verify_plans=self.config.verify_plans)
+                       verify_plans=self.config.verify_plans,
+                       stats_source=self.column_stats)
 
     def sql(self, query: str, backend: Optional[str] = None) -> Table:
         """Run a query; backend "jax" (device) or "numpy" (host oracle).
@@ -342,6 +394,12 @@ class Session:
             result = to_host(jexec.run_query(("sql", query), factory))
             self.last_fallbacks = list(jexec.fallback_nodes)
             self.last_exec_stats = dict(jexec.last_stats)
+            if self.last_fallbacks:
+                # the REASON a query is not fully on-device (operator + why)
+                # rides the stats so runners can enumerate the remaining
+                # host/in-core queries per run without scraping status text
+                self.last_exec_stats["fallback_reasons"] = \
+                    list(self.last_fallbacks)
             return result
         plan = Planner(self._catalog()).plan_query(parse_sql(query))
         executor = Executor(self.load_table)
@@ -358,7 +416,7 @@ class Session:
                 cfg.stream_compact_rows, cfg.shared_scan,
                 cfg.stream_fusion_max_branches, cfg.late_materialization,
                 cfg.late_mat_min_rows, cfg.decimal_physical, cfg.use_jax,
-                tuple(cfg.mesh_shape))
+                cfg.narrow_lanes, tuple(cfg.mesh_shape))
 
     def _sql_streaming(self, query: str):
         """Out-of-core execution (generalized round 5, shared-scan round 7):
@@ -396,10 +454,21 @@ class Session:
                 return None
             groups = streaming.plan_scan_groups(jobs,
                                                 self.config.shared_scan)
+            if self.config.narrow_lanes:
+                # choose each group's per-column upload lanes ONCE from
+                # table-wide column stats: static for every morsel of the
+                # pass (a per-morsel choice would be a width change =
+                # recompile mid-stream), recorded on the morsel ScanNodes
+                # so the verifier can prove them against the same stats
+                from .jax_backend.device import plan_lanes
+                for g in groups:
+                    st = self.column_stats(g.table)
+                    streaming.set_group_lanes(g, plan_lanes(
+                        g.dtypes, [st.get(c) for c in g.columns]))
             if self.config.verify_plans == "per-pass":
                 # fused shared-scan partial plans are plan-IR rewrites that
                 # never pass through planner.PassPipeline — verify them here
-                streaming.verify_groups(groups)
+                streaming.verify_groups(groups, col_stats=self.column_stats)
             # ONE executor serves every group of every job: groups run
             # sequentially, and sharing the scan cache uploads each
             # dimension table once instead of per branch
@@ -487,6 +556,11 @@ class Session:
             "fused_groups": fused_groups,
             "bytes_uploaded": bytes_uploaded,
             "morsels_per_table": morsels_per_table,
+            # narrow-lane packing observability: which physical lane each
+            # streamed column rode (bytes_uploaded above measures the win)
+            "narrow_lanes": bool(self.config.narrow_lanes),
+            "lane_spec": {g.table: dict(zip(g.columns, g.lanes))
+                          for g in groups if g.lanes is not None},
         }
         if prefetch_errs:
             # prefetch failures degrade to synchronous staging — correct but
@@ -617,9 +691,11 @@ class Session:
             return True
 
         def stage(morsel):
-            """Pack + upload one union-column morsel into a fresh buffer."""
+            """Pack + upload one union-column morsel into a fresh buffer
+            (group.lanes = the static narrow-lane spec; None = legacy wide
+            layout under --no_narrow_lanes)."""
             sub = morsel.select(group.columns)
-            packed = pack_table(sub, capacity=cap)
+            packed = pack_table(sub, capacity=cap, lanes=group.lanes)
             return packed if packed is not None else \
                 to_device(sub, capacity=cap)
 

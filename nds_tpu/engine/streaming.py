@@ -475,17 +475,36 @@ class ScanGroup:
     `plans[i]` is members[i]'s partial plan rewritten (fuse_group) to read
     the shared union-column morsel scan; `members[i]` is the (job_index,
     branch_index) it serves. One morsel iterator + one staged upload per
-    morsel serves every member."""
+    morsel serves every member. `lanes` is the STATIC per-column upload
+    lane spec (device.plan_lanes, chosen once from table-wide column stats
+    and held for every morsel of the pass — widths recorded in the plan,
+    never decided per morsel, so they cannot cause mid-stream recompiles);
+    None = the legacy wide int64 layout (narrow_lanes off)."""
     table: str
     columns: list[str]             # union of member pruned column sets
     dtypes: list[str]
     members: list[tuple]           # (job_index, branch_index)
     plans: list[PlanNode]
+    lanes: Optional[tuple] = None
 
     @property
     def morsel_key(self) -> str:
         """The executor scan-cache key every member's program reads."""
         return MORSEL_TABLE + "//" + ",".join(self.columns)
+
+
+def set_group_lanes(group: ScanGroup, lanes: Optional[tuple]) -> None:
+    """Attach a lane spec to a scan group: recorded on the group (the
+    packer's static per-morsel contract) AND on every member plan's morsel
+    ScanNode (width metadata the plan verifier checks against column
+    stats). Copy-on-write — morsel scans may be shared across members."""
+    if lanes is None:
+        return
+    group.lanes = tuple(lanes)
+    for i, p in enumerate(group.plans):
+        scan = _morsel_scan(p)
+        group.plans[i] = substitute_nodes(
+            p, {id(scan): replace(scan, lanes=tuple(lanes))})
 
 
 def _morsel_scan(plan: PlanNode) -> ScanNode:
@@ -553,15 +572,19 @@ def plan_scan_groups(jobs: list[StreamJob], shared: bool) -> list[ScanGroup]:
     return groups
 
 
-def verify_groups(groups: list[ScanGroup]) -> None:
+def verify_groups(groups: list[ScanGroup], col_stats=None) -> None:
     """Static verification of shared-scan fused partial plans: fuse_group
     rewrites every member's morsel scan into a union-column view, which is
     a plan-IR transform like any planner pass — a bad column mapping there
-    silently serves one branch another branch's columns. Run by the
-    session when EngineConfig.verify_plans == "per-pass" (the groups never
-    flow through planner.PassPipeline); raises PlanVerifyError naming the
-    group/member as the offending pass."""
-    from .verify import PlanVerifyError, verify_plan
+    silently serves one branch another branch's columns. With `col_stats`
+    (callable table -> {column: (lo, hi)}), the group's upload lane spec is
+    additionally proven wide enough for every column's recorded value range
+    (a lane too narrow would otherwise only surface as a pack-time
+    LaneOverflowError mid-stream). Run by the session when
+    EngineConfig.verify_plans == "per-pass" (the groups never flow through
+    planner.PassPipeline); raises PlanVerifyError naming the group/member
+    as the offending pass."""
+    from .verify import PlanVerifyError, check_scan_lanes, verify_plan
 
     for gi, g in enumerate(groups):
         for mi, p in enumerate(g.plans):
@@ -569,6 +592,14 @@ def verify_groups(groups: list[ScanGroup]) -> None:
             if findings:
                 raise PlanVerifyError(
                     findings, f"stream_fusion[group {gi} member {mi}]")
+        if g.lanes is not None and col_stats is not None:
+            stats = col_stats(g.table)
+            findings = check_scan_lanes(
+                _morsel_scan(g.plans[0]),
+                {c: stats.get(c) for c in g.columns})
+            if findings:
+                raise PlanVerifyError(findings,
+                                      f"narrow_lanes[group {gi}]")
 
 
 def _expr_subplans(node: PlanNode):

@@ -74,7 +74,7 @@ class Finding:
     node: object            # the offending PlanNode
     label: str              # stable preorder label, e.g. "ProjectNode#4"
     kind: str               # arity | colref | colname | dtype | agg | window
-    #                       | joinkey | setop | scan | frozen | params
+    #                       | joinkey | setop | scan | lane | frozen | params
     message: str
 
     def __str__(self) -> str:
@@ -438,6 +438,7 @@ class _Verifier:
             return
         if list(n.out_names) != list(n.columns):
             self._add(n, "scan", "out_names diverge from physical columns")
+        self._chk_lanes(n)
         if self.catalog is None or n.table.startswith(MORSEL_TABLE):
             return
         try:
@@ -454,6 +455,28 @@ class _Verifier:
                 self._add(n, "dtype",
                           f"column {n.table}.{c} is {dtypes[pos[c]]!r} in "
                           f"the catalog but scans as {d!r}")
+
+    def _chk_lanes(self, n: P.ScanNode) -> None:
+        """Width metadata legality: every declared upload lane must be able
+        to carry its column's logical dtype at all, and (when the catalog
+        records value-range stats) be wide enough for the column's actual
+        range — a too-narrow lane would truncate values on the wire."""
+        if n.lanes is None:
+            return
+        from .jax_backend.device import lane_legal
+        if len(n.lanes) != len(n.columns):
+            self._add(n, "lane",
+                      f"{len(n.lanes)} lanes vs {len(n.columns)} columns")
+            return
+        for c, d, lane in zip(n.columns, n.out_dtypes, n.lanes):
+            if not lane_legal(lane, d):
+                self._add(n, "lane",
+                          f"column {c!r}: lane {lane!r} cannot carry "
+                          f"dtype {d!r}")
+        from .streaming import MORSEL_TABLE
+        stats_of = getattr(self.catalog, "col_stats", None)
+        if stats_of is not None and not n.table.startswith(MORSEL_TABLE):
+            self.findings.extend(_lane_stat_findings(n, stats_of(n.table)))
 
     def _chk_FilterNode(self, n: P.FilterNode, w: int) -> None:
         self._require_passthrough(n, w)
@@ -664,6 +687,48 @@ class _Verifier:
     def _chk_VirtualScanNode(self, n: P.VirtualScanNode, w: int) -> None:
         if not n.key:
             self._add(n, "scan", "virtual scan without a segment key")
+
+
+def _lane_stat_findings(n: P.ScanNode, stats: dict) -> list[Finding]:
+    """Lane-vs-value-range findings for one scan with declared lanes.
+    stats: {column: (lo, hi) in engine units, or None = unknown}. Unknown
+    ranges only pass on lanes that are range-free for the dtype (the
+    widest legal lane); a NARROW lane without stats is itself a finding —
+    nothing proves the column fits."""
+    from .jax_backend.device import _LANE_BOUNDS, plan_lanes
+
+    out: list[Finding] = []
+    for c, d, lane in zip(n.columns, n.out_dtypes, n.lanes):
+        bounds = _LANE_BOUNDS.get(lane)
+        if bounds is None:      # b1 / f64: dtype legality already checked
+            continue
+        st = stats.get(c)
+        if st is None:
+            widest = plan_lanes([d], [None])
+            if widest is not None and lane != widest[0] and d != "str":
+                out.append(Finding(
+                    n, "", "lane",
+                    f"column {c!r}: narrow lane {lane!r} declared but no "
+                    f"value-range stats prove it fits"))
+            continue
+        lo, hi = int(st[0]), int(st[1])
+        if lo < bounds[0] or hi > bounds[1]:
+            out.append(Finding(
+                n, "", "lane",
+                f"column {c!r}: recorded range [{lo}, {hi}] overflows "
+                f"lane {lane!r} bounds {list(bounds)}"))
+    return out
+
+
+def check_scan_lanes(scan: P.ScanNode, stats: dict) -> list[Finding]:
+    """Standalone lane/stats legality check for a (morsel) scan whose
+    table is not in any catalog — streaming.verify_groups feeds it the
+    big table's column stats keyed by the scan's column names."""
+    if scan.lanes is None:
+        return []
+    findings = _lane_stat_findings(scan, stats)
+    _fill_labels(findings, scan, None)
+    return findings
 
 
 def check_params(root: P.PlanNode) -> list[Finding]:

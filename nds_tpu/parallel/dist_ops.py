@@ -21,9 +21,23 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
 
 from ..engine.jax_backend import kernels
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma=True):
+    """Version-portable shard_map: newer jax exports it top-level with a
+    `check_vma` kwarg; older releases keep it in jax.experimental with the
+    same knob named `check_rep`. Every call site in this tree routes
+    through here so the mesh path runs on both."""
+    try:
+        from jax import shard_map as _sm
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_vma=check_vma)
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _sm
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=check_vma)
 
 _I32 = jnp.int32
 
@@ -87,8 +101,8 @@ def repartition_by_key(mesh: Mesh, per_pair_capacity: int,
         boundary = jnp.concatenate(
             [jnp.ones(1, bool), dest_sorted[1:] != dest_sorted[:-1]])
         pos_in_block = jnp.arange(cap, dtype=_I32) - \
-            jnp.maximum.accumulate(
-                jnp.where(boundary, jnp.arange(cap, dtype=_I32), 0))
+            lax.cummax(jnp.where(boundary, jnp.arange(cap, dtype=_I32), 0),
+                       axis=0)
         slot_sorted = pos_in_block
         overflow = jnp.sum((slot_sorted >= per_pair_capacity) &
                            (dest_sorted < n_shards)).astype(_I32)

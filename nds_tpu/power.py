@@ -166,6 +166,7 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
                      resume: bool = False,
                      late_mat: bool | None = None,
                      shared_scan: bool | None = None,
+                     narrow_lanes: bool | None = None,
                      verify_plans: str | None = None
                      ) -> list[tuple[str, int, int, int]]:
     """Run every query in the stream; returns (name, start_ms, end_ms, ms).
@@ -197,6 +198,8 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
     resume: skip queries already recorded in an existing (flushed partial)
     time log — a multi-hour stream interrupted mid-run restarts where it
     stopped, keeping the original Power Start Time.
+    narrow_lanes: --no_narrow_lanes A/B override (None = config): False
+    restores the wide int64 morsel upload layout bit-identically.
     verify_plans: static plan-IR verification mode (off|final|per-pass,
     engine/verify.py) — None takes EngineConfig.verify_plans.
     """
@@ -212,6 +215,8 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
         config.late_materialization = late_mat
     if shared_scan is not None:  # --no_shared_scan A/B override
         config.shared_scan = shared_scan
+    if narrow_lanes is not None:  # --no_narrow_lanes A/B override
+        config.narrow_lanes = narrow_lanes
     if verify_plans is not None:  # --verify_plans override
         config.verify_plans = verify_plans
     session = Session(config)
@@ -463,6 +468,12 @@ def main(argv: list[str] | None = None) -> int:
                         "branch) for A/B runs — each branch then streams "
                         "its table separately, the pre-round-7 behavior; "
                         "property: nds.tpu.shared_scan")
+    p.add_argument("--no_narrow_lanes", action="store_true",
+                   help="disable narrow-lane packed uploads (per-column "
+                        "u8/u16/u32 morsel lanes chosen from column stats "
+                        "+ bit-packed validity) for A/B runs — morsels "
+                        "then ride the wide int64 layout, bit-identical "
+                        "results; property: nds.tpu.narrow_lanes")
     a = p.parse_args(argv)
     sub = a.sub_queries.split(",") if a.sub_queries else None
     inject = a.fault_inject.split(",") if a.fault_inject else None
@@ -476,6 +487,7 @@ def main(argv: list[str] | None = None) -> int:
                      resume=a.resume,
                      late_mat=False if a.no_late_mat else None,
                      shared_scan=False if a.no_shared_scan else None,
+                     narrow_lanes=False if a.no_narrow_lanes else None,
                      verify_plans=a.verify_plans)
     return 0
 

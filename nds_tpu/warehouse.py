@@ -39,6 +39,15 @@ TABLE_PARTITIONING = {
 }
 
 
+def _read_file(path: str) -> pa.Table:
+    """Read ONE data file by exact path. A bare pq.read_table infers hive
+    partitioning from the `<col>=<val>` directory component and then
+    refuses to merge the inferred dictionary field with the identical
+    column KEPT in the file — warehouse files always carry their partition
+    column, so partition inference must stay off."""
+    return pq.read_table(path, partitioning=None)
+
+
 def _partition_value(path: str):
     """Partition value from a file path's `<col>=<val>` directory component
     (None for unpartitioned files; the null partition yields "null")."""
@@ -48,29 +57,40 @@ def _partition_value(path: str):
     return d.split("=", 1)[1]
 
 
-# Columns whose per-file [min, max] land in the manifest at write time:
-# ticket/order numbers — the DF_* IN-subquery deletes probe exactly these,
-# and file stats are the only way to prune them (they do not correlate
-# with the date partition layout). Reference analog: Iceberg per-file
-# column metrics driving metadata-pruned deletes
-# (nds/nds_maintenance.py:146-185).
-STATS_COLUMN_SUFFIXES = ("_number",)
+# Per-file [min, max] column metrics land in the manifest at write time
+# for EVERY integer/date/decimal column (decimals stored as exact SCALED
+# ints — engine units under decimal_physical="i64", JSON-safe either way):
+# ticket/order numbers drive metadata-pruned DF_* deletes (the original
+# use; reference analog Iceberg column metrics, nds_maintenance.py:146-185),
+# and the full-column coverage feeds narrow-lane upload planning
+# (Session.column_stats -> device.plan_lanes) without touching data files.
+STATS_COLUMN_SUFFIXES = ("_number",)   # kept: delete-prune probe columns
+
+
+def _stats_value(t: pa.DataType, v):
+    """Manifest-serializable engine-unit stat for one arrow scalar value."""
+    if pa.types.is_date(t):
+        import datetime
+        return (v - datetime.date(1970, 1, 1)).days
+    if pa.types.is_decimal(t):
+        return int(v.scaleb(t.scale))
+    return int(v)
 
 
 def _file_stats(table: pa.Table) -> dict:
     import pyarrow.compute as pc
     out = {}
     for name in table.column_names:
-        if not name.endswith(STATS_COLUMN_SUFFIXES):
-            continue
         col = table.column(name)
-        if not pa.types.is_integer(col.type):
+        t = col.type
+        if not (pa.types.is_integer(t) or pa.types.is_date(t)
+                or pa.types.is_decimal(t)):
             continue
         mm = pc.min_max(col)
         mn, mx = mm["min"].as_py(), mm["max"].as_py()
         if mn is None:
             continue
-        out[name] = [mn, mx]
+        out[name] = [_stats_value(t, mn), _stats_value(t, mx)]
     return out
 
 
@@ -121,6 +141,28 @@ class WarehouseTable:
         """{relative file path: {column: [min, max]}} for files written
         with stats (older warehouses: empty — those files never prune)."""
         return self._load_doc()["file_stats"]
+
+    def column_stats(self, files, dec_as_int: bool = False) -> dict:
+        """Table-wide {column: (lo, hi)} over the given snapshot files, in
+        engine units. Manifest-recorded per-file stats aggregate for free;
+        columns some file lacks stats for (older warehouses, partial
+        manifests) fall back to ONE parquet-metadata pass — still no data
+        read. Feeds narrow-lane upload planning (device.plan_lanes)."""
+        from .engine.arrow_bridge import parquet_column_stats
+
+        rec = self.file_stats()
+        per_file = [rec.get(os.path.relpath(f, self.dir)) for f in files]
+        agg: dict = {}
+        if per_file and all(p is not None for p in per_file):
+            common = set(per_file[0])
+            for p in per_file[1:]:
+                common &= set(p)
+            for col in common:
+                agg[col] = (min(p[col][0] for p in per_file),
+                            max(p[col][1] for p in per_file))
+        if not agg and files:
+            agg = parquet_column_stats(list(files), dec_as_int)
+        return agg
 
     def exists(self) -> bool:
         return os.path.exists(self.manifest_path)
@@ -284,7 +326,7 @@ class WarehouseTable:
         batch_tables: list[pa.Table] = []
         rows = 0
         for path in paths:
-            t = pq.read_table(path)
+            t = _read_file(path)
             batch_paths.append(path)
             batch_tables.append(t)
             rows += t.num_rows
@@ -313,7 +355,7 @@ class WarehouseTable:
         files = self.current_files()
         if not files:
             raise FileNotFoundError(f"table {self.name} has no snapshot")
-        return pa.concat_tables([pq.read_table(f) for f in files],
+        return pa.concat_tables([_read_file(f) for f in files],
                                 promote_options="permissive")
 
 
@@ -361,5 +403,8 @@ class Warehouse:
                 cols = list(columns) if columns is not None else None
                 yield from ds.to_batches(columns=cols)
             session._batch_sources[name] = batches
+            session._stats_sources[name] = \
+                lambda wt=wt, files=tuple(files), dec=dec: \
+                wt.column_stats(files, dec)
             session._drop_cached(name)
             session._generation += 1

@@ -49,6 +49,7 @@ def star_session():
     return s
 
 
+@pytest.mark.slow  # whole-plan GSPMD compile + HLO inspection
 def test_star_query_collectives_bounded(star_session):
     s = star_session
     sql = ("SELECT d.grp, sum(f.v), count(*) FROM fact f, dim d "
@@ -102,6 +103,7 @@ def factfact_session():
     return s
 
 
+@pytest.mark.slow  # whole-plan GSPMD compile + HLO inspection
 def test_fact_fact_join_shuffles_not_gathers(factfact_session):
     """q64/q78/q95-class fact-fact joins on the mesh must repartition via
     all_to_all (Spark shuffle join), never rebuild a fact side with a

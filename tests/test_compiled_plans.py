@@ -100,6 +100,7 @@ def test_jit_plans_off():
     assert s._jax_exec._plans == {}
 
 
+@pytest.mark.slow  # 8-virtual-device whole-plan compile
 def test_mesh_sharded_compiled_run():
     """8-virtual-device SPMD: fact scan row-sharded, plan GSPMD-partitioned."""
     import jax

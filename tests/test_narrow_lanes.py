@@ -1,0 +1,396 @@
+"""Narrow-lane packed uploads + encoded execution (round 9).
+
+The packed-table layout carries every column at its minimal physical width
+(device.plan_lanes/pack_table: u8/u16/u32/i32 lanes from dtype + value-range
+stats, bit-packed validity, one contiguous byte buffer) and execution keeps
+32-bit-range columns on i32 device arrays. Exactness is pinned by a
+property-style pack/unpack round trip over dtypes x lanes x validity
+patterns, a --no_narrow_lanes bit-identity differential on a streamed bench
+shape, and verifier checks that a lane too narrow for its column's recorded
+range is caught statically (verify.check_scan_lanes / ScanNode.lanes)."""
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from nds_tpu.config import EngineConfig
+from nds_tpu.engine import Session
+from nds_tpu.engine import arrow_bridge
+from nds_tpu.engine.column import Column, Table
+from nds_tpu.engine.jax_backend.device import (
+    LaneOverflowError, device_bytes, lane_bytes, lane_legal, pack_table,
+    plan_lanes, to_device, to_host, unpack_table)
+
+N_FACT, N_DIM = 50_000, 300
+CHUNK = 4_096
+
+
+# ---------------------------------------------------------------------------
+# pack/unpack round trip: dtypes x lane widths x validity patterns
+# ---------------------------------------------------------------------------
+
+def _col(dtype, data, valid=None, dictionary=None):
+    return Column.from_values(dtype, np.asarray(data), valid, dictionary)
+
+
+def _validity(pattern, n, rng):
+    if pattern == "none_null":
+        return None
+    if pattern == "all_null":
+        return np.zeros(n, dtype=bool)
+    return rng.random(n) < 0.7
+
+
+_CASES = [
+    # (name, dtype, generator(lo..hi ints), stats, expected lane)
+    ("int_u8", "int", (0, 255), (0, 255), "u8"),
+    ("int_u16", "int", (0, 60_000), (0, 65_535), "u16"),
+    ("int_u32", "int", (0, 2 ** 30), (0, 2 ** 31 - 1), "u32"),
+    ("int_i32_neg", "int", (-1000, 1000), (-1000, 1000), "i32"),
+    ("int_i64", "int", (-2 ** 40, 2 ** 40), (-2 ** 40, 2 ** 40), "i64"),
+    ("dec2_u16", "dec2", (0, 50_000), (0, 65_535), "u16"),
+    ("dec2_i64", "dec2", (-10 ** 12, 10 ** 12), (-10 ** 12, 10 ** 12),
+     "i64"),
+    ("date_u16", "date", (0, 40_000), (0, 40_000), "u16"),
+    ("date_i32", "date", (0, 80_000), (0, 80_000), "i32"),
+]
+
+
+@pytest.mark.parametrize("pattern", ["none_null", "mixed", "all_null"])
+@pytest.mark.parametrize("name,dtype,rng_range,stats,want_lane",
+                         _CASES, ids=[c[0] for c in _CASES])
+def test_roundtrip_int_family(name, dtype, rng_range, stats, want_lane,
+                              pattern):
+    rng = np.random.default_rng(hash((name, pattern)) % 2 ** 31)
+    n = 700
+    data = rng.integers(rng_range[0], rng_range[1] + 1, n)
+    valid = _validity(pattern, n, rng)
+    t = Table([name], [_col(dtype, data, valid)])
+    lanes = plan_lanes([dtype], [stats])
+    assert lanes == (want_lane,)
+    packed = pack_table(t, capacity=1024, lanes=lanes)
+    assert packed is not None
+    got = to_host(unpack_table(packed))
+    want = to_host(to_device(t, capacity=1024))
+    np.testing.assert_array_equal(np.asarray(got.columns[0].data),
+                                  np.asarray(want.columns[0].data))
+    np.testing.assert_array_equal(got.columns[0].validity,
+                                  want.columns[0].validity)
+
+
+@pytest.mark.parametrize("pattern", ["none_null", "mixed", "all_null"])
+def test_roundtrip_float_bool_str(pattern):
+    rng = np.random.default_rng(hash(pattern) % 2 ** 31)
+    n = 700
+    fvals = rng.normal(size=n)
+    bvals = rng.integers(0, 2, n).astype(bool)
+    # max-code strings: every code of a full u8-sized dictionary occurs
+    dict256 = np.asarray([f"v{i}" for i in range(256)], dtype=object)
+    codes = rng.integers(0, 256, n).astype(np.int32)
+    codes[:256] = np.arange(256)
+    valid = _validity(pattern, n, rng)
+    t = Table(["f", "b", "s"], [
+        _col("float", fvals, valid),
+        _col("bool", bvals, valid),
+        Column("str", codes, valid, dict256),
+    ])
+    lanes = plan_lanes(["float", "bool", "str"], [None] * 3,
+                       dict_sizes=[None, None, 256])
+    assert lanes == ("f64", "b1", "u8")
+    packed = pack_table(t, capacity=1024, lanes=lanes)
+    got = to_host(unpack_table(packed))
+    want = to_host(to_device(t, capacity=1024))
+    for g, w in zip(got.columns, want.columns):
+        np.testing.assert_array_equal(np.asarray(g.data), np.asarray(w.data))
+        np.testing.assert_array_equal(g.validity, w.validity)
+    # the str dictionary must survive the packed round trip
+    assert list(got.columns[2].decode()) == list(want.columns[2].decode())
+
+
+def test_narrow_lanes_reject_out_of_range_values():
+    """Negative / oversized values must REJECT the narrow lane loudly:
+    silent wraparound would alias unrelated rows."""
+    neg = Table(["x"], [_col("int", np.asarray([-3, 1, 2]))])
+    with pytest.raises(LaneOverflowError):
+        pack_table(neg, capacity=8, lanes=("u8",))
+    big = Table(["x"], [_col("int", np.asarray([0, 70_000]))])
+    with pytest.raises(LaneOverflowError):
+        pack_table(big, capacity=8, lanes=("u16",))
+    f = Table(["x"], [_col("float", np.asarray([0.5]))])
+    with pytest.raises(LaneOverflowError):
+        pack_table(f, capacity=8, lanes=("u8",))
+
+
+def test_lane_planning_rules():
+    # stats-driven narrowing never picks an unsigned lane for negatives
+    assert plan_lanes(["int"], [(-5, 5)]) == ("i32",)
+    assert plan_lanes(["int"], [(0, 200)]) == ("u8",)
+    assert plan_lanes(["int"], [(0, 2 ** 31 - 1)]) == ("u32",)
+    assert plan_lanes(["int"], [(0, 2 ** 31)]) == ("i64",)
+    assert plan_lanes(["int"], [None]) == ("i64",)   # unknown -> widest
+    assert plan_lanes(["date"], [None]) == ("i32",)
+    # legacy wide layout (--no_narrow_lanes): ints ride int64, bools and
+    # strings fall back to the per-column path exactly like the old packer
+    assert plan_lanes(["int", "date", "float"], narrow=False) == \
+        ("i64", "i32", "f64")
+    assert plan_lanes(["bool"], narrow=False) is None
+    assert plan_lanes(["str"], narrow=False) is None
+    assert not lane_legal("u8", "float")
+    assert not lane_legal("b1", "int")
+    assert lane_legal("b1", "bool")
+
+
+def test_packed_bytes_accounting():
+    t = Table(["a", "b"], [_col("int", np.arange(100)),
+                           _col("bool", np.zeros(100, dtype=bool))])
+    lanes = plan_lanes(["int", "bool"], [(0, 99), None])
+    packed = pack_table(t, capacity=128, lanes=lanes)
+    # u8 data (128) + b1 data (16) + 3 bit-packed masks (16 each)
+    assert device_bytes(packed) == lane_bytes(lanes, 128) == 128 + 16 + 48
+
+
+# ---------------------------------------------------------------------------
+# streamed differential: narrow on vs off bit-identical, >= 2x fewer bytes
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def bench_shape(tmp_path_factory):
+    """An NDS-fact-shaped table: int64 surrogate keys with small ranges,
+    a small-int quantity, an f64 price, a date-like key, an 8-bit flag."""
+    tmp = tmp_path_factory.mktemp("narrow_lanes")
+    rng = np.random.default_rng(23)
+    qty = rng.integers(1, 100, N_FACT).astype(object)
+    qty[rng.random(N_FACT) < 0.05] = None
+    fact = pa.table({
+        "fk": pa.array(rng.integers(0, N_DIM + 5, N_FACT), type=pa.int64()),
+        "qty": pa.array(list(qty), type=pa.int32()),
+        "price": pa.array(np.round(rng.uniform(1, 100, N_FACT), 2)),
+        "day": pa.array(rng.integers(2_450_000, 2_453_000, N_FACT),
+                        type=pa.int64()),
+        "flag": pa.array(rng.integers(0, 2, N_FACT).astype(bool)),
+    })
+    path = os.path.join(str(tmp), "fact.parquet")
+    pq.write_table(fact, path, row_group_size=8192)
+    dim = pa.table({"dk": pa.array(np.arange(N_DIM), type=pa.int32()),
+                    "grp": pa.array((np.arange(N_DIM) % 13)
+                                    .astype(np.int32))})
+    return {"fact_path": path, "dim": dim}
+
+
+Q_BENCH = """
+SELECT d.grp, SUM(f.qty) AS s, COUNT(*) AS c, MIN(f.day) AS md,
+       SUM(f.price) AS sp
+FROM fact f JOIN dim d ON f.fk = d.dk
+WHERE f.day < 2452500 AND f.flag
+GROUP BY d.grp ORDER BY d.grp
+"""
+
+
+def _session(data, narrow, **kw):
+    cfg = EngineConfig(out_of_core=True, chunk_rows=CHUNK,
+                       out_of_core_min_rows=10_000, narrow_lanes=narrow,
+                       **kw)
+    s = Session(cfg)
+    s.register_parquet("fact", data["fact_path"])
+    s.register_arrow("dim", data["dim"])
+    return s
+
+
+def rows_of(t):
+    return [tuple(r) for r in t.to_pylist()]
+
+
+def test_narrow_off_bit_identical_and_2x_bytes(bench_shape):
+    """Acceptance: default (narrow) vs --no_narrow_lanes results are
+    BIT-IDENTICAL while bytes_uploaded drops >= 2x, with per-pass plan
+    verification (incl. lane/stats legality) green in both modes."""
+    s_on = _session(bench_shape, True, verify_plans="per-pass")
+    on = rows_of(s_on.sql(Q_BENCH, backend="jax"))
+    st_on = dict(s_on.last_exec_stats)
+    s_off = _session(bench_shape, False, verify_plans="per-pass")
+    off = rows_of(s_off.sql(Q_BENCH, backend="jax"))
+    st_off = dict(s_off.last_exec_stats)
+    assert st_on["mode"] == st_off["mode"] == "streaming"
+    assert on == off
+    assert st_on["narrow_lanes"] and not st_off["narrow_lanes"]
+    assert st_on["bytes_uploaded"] * 2 <= st_off["bytes_uploaded"]
+    lanes = st_on["lane_spec"]["fact"]
+    assert lanes["fk"] == "u16" and lanes["qty"] == "u8"
+    assert lanes["day"] == "u32" and lanes["flag"] == "b1"
+    assert lanes["price"] == "f64"
+    assert st_off.get("lane_spec") == {}
+    # numpy oracle (float tolerance on the f64 sum only)
+    oracle = rows_of(_session(bench_shape, True)
+                     .sql(Q_BENCH, backend="numpy"))
+    assert len(on) == len(oracle)
+    for a, b in zip(on, oracle):
+        assert a[:4] == b[:4]
+        assert abs(a[4] - b[4]) <= 1e-6 * max(1.0, abs(b[4]))
+
+
+def test_live_toggle_invalidates_stream_cache(bench_shape):
+    """narrow_lanes is part of the stream-cache config fingerprint: a live
+    toggle must re-derive groups/lanes/programs, not replay stale ones."""
+    s = _session(bench_shape, True)
+    a = rows_of(s.sql(Q_BENCH, backend="jax"))
+    assert s.last_exec_stats["narrow_lanes"]
+    s.config.narrow_lanes = False
+    b = rows_of(s.sql(Q_BENCH, backend="jax"))
+    assert not s.last_exec_stats["narrow_lanes"]
+    assert s.last_exec_stats.get("lane_spec") == {}
+    assert a == b
+
+
+def test_lanes_static_across_skewed_morsels(bench_shape, tmp_path):
+    """Morsel widths are decided ONCE per schedule from table-wide stats:
+    a first morsel whose local range would fit a narrower lane must still
+    ride the table-wide lane (no mid-stream width change, no re-record)."""
+    n = 40_000
+    vals = np.concatenate([np.zeros(n - 100, dtype=np.int64),
+                           np.full(100, 60_000, dtype=np.int64)])
+    t = pa.table({"k": pa.array(np.arange(n) % N_DIM, type=pa.int64()),
+                  "v": pa.array(vals)})
+    path = os.path.join(str(tmp_path), "skew.parquet")
+    pq.write_table(t, path, row_group_size=8192)
+    s = Session(EngineConfig(out_of_core=True, chunk_rows=CHUNK,
+                             out_of_core_min_rows=10_000))
+    s.register_parquet("skew", path)
+    got = rows_of(s.sql(
+        "SELECT SUM(v) s, MAX(v) m, COUNT(*) c FROM skew",
+        backend="jax"))
+    st = s.last_exec_stats
+    assert st["mode"] == "streaming"
+    assert st["lane_spec"]["skew"]["v"] == "u16"   # table-wide, not u8
+    assert st["re_records"] == 0
+    assert got == [(100 * 60_000, 60_000, n)]
+
+
+# ---------------------------------------------------------------------------
+# verifier: width metadata legality
+# ---------------------------------------------------------------------------
+
+def test_verifier_catches_too_narrow_lane():
+    from nds_tpu.engine.plan import ScanNode
+    from nds_tpu.engine.verify import check_scan_lanes, verify_plan
+
+    scan = ScanNode("__morsel__", ["a", "b"], lanes=("u8", "u16"),
+                    out_names=["a", "b"], out_dtypes=["int", "int"])
+    ok = check_scan_lanes(scan, {"a": (0, 255), "b": (0, 65_535)})
+    assert ok == []
+    bad = check_scan_lanes(scan, {"a": (0, 999), "b": (-1, 10)})
+    assert len(bad) == 2 and all(f.kind == "lane" for f in bad)
+    # a narrow lane with NO stats proving it fits is itself a finding
+    unproven = check_scan_lanes(scan, {"a": None, "b": (0, 10)})
+    assert len(unproven) == 1 and "no value-range stats" in \
+        unproven[0].message
+    # dtype-level legality is independent of stats (verify_plan path)
+    illegal = ScanNode("__morsel__", ["f"], lanes=("u8",),
+                       out_names=["f"], out_dtypes=["float"])
+    findings = verify_plan(illegal)
+    assert any(f.kind == "lane" and "cannot carry" in f.message
+               for f in findings)
+
+
+def test_verify_groups_rejects_lying_stats(bench_shape):
+    """Session-level: per-pass verification proves each group's lane spec
+    against the SAME stats source the planner used — a lane too narrow for
+    the recorded range aborts before any morsel ships on it."""
+    from nds_tpu.engine import streaming
+    from nds_tpu.engine.verify import PlanVerifyError
+
+    s = _session(bench_shape, True, verify_plans="per-pass")
+    sent_q = Q_BENCH
+    # first, an honest run primes nothing stale and passes
+    s.sql(sent_q, backend="jax")
+    ent = s._stream_cache[sent_q]
+    g = ent["groups"][0]
+    narrowed = tuple("u8" if ln in ("u16", "u32") else ln
+                     for ln in g.lanes)
+    streaming.set_group_lanes(g, narrowed)
+    with pytest.raises(PlanVerifyError) as exc:
+        streaming.verify_groups(ent["groups"], col_stats=s.column_stats)
+    assert "narrow_lanes" in str(exc.value)
+
+
+# ---------------------------------------------------------------------------
+# column stats sources: arrow tables, parquet metadata, warehouse manifests
+# ---------------------------------------------------------------------------
+
+def test_stats_sources_arrow_and_parquet(tmp_path):
+    import decimal
+    t = pa.table({
+        "i": pa.array([3, None, 999_999], type=pa.int64()),
+        "d": pa.array([10_957, 11_000, 10_958], type=pa.date32()),
+        "dec": pa.array([decimal.Decimal("1.25"), None,
+                         decimal.Decimal("-3.50")],
+                        type=pa.decimal128(10, 2)),
+        "s": pa.array(["x", "y", "z"]),
+    })
+    path = os.path.join(str(tmp_path), "t.parquet")
+    pq.write_table(t, path)
+    s = Session(EngineConfig(decimal_physical="i64"))
+    s.register_arrow("mem", t)
+    s.register_parquet("disk", path)
+    for name in ("mem", "disk"):
+        st = s.column_stats(name)
+        assert st["i"] == (3, 999_999)
+        assert st["d"] == (10_957, 11_000)
+        assert st["dec"] == (-350, 125)      # engine units: scaled ints
+        assert "s" not in st
+    # re-registration invalidates the cache
+    s.register_arrow("mem", t.slice(0, 1))
+    assert s.column_stats("mem")["i"] == (3, 3)
+
+
+def test_warehouse_manifest_stats_every_column(tmp_path):
+    import decimal
+    from nds_tpu.warehouse import Warehouse
+
+    wh = Warehouse(str(tmp_path))
+    t = pa.table({
+        "ss_ticket_number": pa.array([7, 3, 11], type=pa.int64()),
+        "ss_sold_date_sk": pa.array([2_450_816, 2_450_820, 2_450_818],
+                                    type=pa.int64()),
+        "ss_sales_price": pa.array([decimal.Decimal("9.99"),
+                                    decimal.Decimal("0.50"), None],
+                                   type=pa.decimal128(7, 2)),
+        "ss_date": pa.array([10_957, 10_958, 10_959], type=pa.date32()),
+    })
+    wt = wh.table("demo")
+    wt.create(t, partition=False)
+    stats = wt.file_stats()
+    assert len(stats) == 1
+    (per_file,) = stats.values()
+    # every integer/date/decimal column lands in the manifest (engine
+    # units), not just the *_number delete-prune columns
+    assert per_file["ss_ticket_number"] == [3, 11]
+    assert per_file["ss_sold_date_sk"] == [2_450_816, 2_450_820]
+    assert per_file["ss_sales_price"] == [50, 999]
+    assert per_file["ss_date"] == [10_957, 10_959]
+    agg = wt.column_stats(wt.current_files(), dec_as_int=True)
+    assert agg["ss_ticket_number"] == (3, 11)
+    s = Session(EngineConfig(decimal_physical="i64"))
+    wh.register_all(s)
+    assert s.column_stats("demo")["ss_sales_price"] == (50, 999)
+
+
+# ---------------------------------------------------------------------------
+# satellite: dictionary arrays load without the to_pylist Python loop
+# ---------------------------------------------------------------------------
+
+def test_dictionary_column_fast_path():
+    vals = [f"cat{i}" for i in range(1000)]
+    arr = pa.array(vals + [None, "cat0"]).dictionary_encode()
+    chunked = pa.chunked_array([arr.slice(0, 500), arr.slice(500)])
+    for a in (arr, chunked):
+        col = arrow_bridge.from_arrow_column(a)
+        assert col.dtype == "str"
+        decoded = list(col.decode())
+        assert decoded == vals + [None, "cat0"]
+        assert col.data.dtype == np.int32
+    # plain strings still encode exactly once and round-trip
+    plain = pa.array(["b", None, "a", "b"])
+    col = arrow_bridge.from_arrow_column(plain)
+    assert list(col.decode()) == ["b", None, "a", "b"]

@@ -234,6 +234,7 @@ def mesh_session(tmp_path_factory):
     return spmd, oracle
 
 
+@pytest.mark.slow  # minutes of 8-virtual-device GSPMD compiles on CPU
 @pytest.mark.parametrize("number", MESH_POWER_SUBSET)
 def test_power_subset_on_mesh_passes_validator(mesh_session, number):
     from nds_tpu import streams, validate

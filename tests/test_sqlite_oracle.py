@@ -49,7 +49,14 @@ def test_template_vs_sqlite(env, number):
             expected = conn.execute(lite_sql).fetchall()
         except sqlite3.OperationalError as e:
             # skip budget is ZERO (round-2 verdict): every template is known
-            # to translate, so a dialect regression must FAIL, not skip
+            # to translate, so a dialect regression must FAIL, not skip.
+            # Sole carve-out: the ORACLE itself may be too old — FULL OUTER
+            # JOIN needs sqlite >= 3.39 (q51/q97), a host-library capability,
+            # not a translation regression
+            if "FULL OUTER JOIN" in str(e) and \
+                    sqlite3.sqlite_version_info < (3, 39):
+                pytest.skip(f"host sqlite {sqlite3.sqlite_version} predates "
+                            f"FULL OUTER JOIN (needs 3.39) for {name}")
             pytest.fail(f"sqlite dialect translation regressed for {name}: "
                         f"{e}\n{lite_sql}")
         actual = session.sql(part_sql, backend="numpy")

@@ -20,10 +20,18 @@ rounds; delete the directory to force regeneration.
 
 Prints: {"metric", "value", "unit", "vs_baseline"} — value is the power-run
 subset wall (ms) on the device path; vs_baseline > 1 means the device path
-beats the host oracle.
+beats the host oracle. Everything else (per-query diagnostics) goes to
+stderr through the nds_tpu.obs.log channel (NDS_TPU_VERBOSITY / -q).
+
+--trace: enable the obs span tracer for the whole run and write a Chrome
+trace-event file (opens in Perfetto / chrome://tracing) plus a JSONL event
+log next to the bench data; the JSON line gains the per-span aggregate,
+the per-program device-time table with per-program roofline fractions,
+and the engine metrics snapshot.
 """
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import subprocess
@@ -100,14 +108,39 @@ def _host_cache_tag() -> str:
     return hashlib.sha1(probe.encode()).hexdigest()[:10]
 
 
-def main() -> None:
+def _parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="bench.py",
+        description="timed NDS bench slice (one JSON line on stdout)")
+    p.add_argument("--trace", action="store_true",
+                   help="enable span tracing; writes a Chrome trace-event "
+                        "file (Perfetto) + JSONL event log under the bench "
+                        "data dir and embeds the span aggregate in the JSON")
+    p.add_argument("--trace_dir", default=None,
+                   help="directory for trace artifacts (default: bench "
+                        "data dir)")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="suppress per-query diagnostic lines (verbosity 0)")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> None:
+    args = _parse_args(argv)
     from nds_tpu.config import EngineConfig, enable_compile_cache, enable_x64
     enable_compile_cache(os.path.join(
         os.path.expanduser("~"), ".cache",
         f"nds_tpu_xla_{_host_cache_tag()}"))
 
     from nds_tpu.engine import Session
+    from nds_tpu.obs import log as obs_log
+    from nds_tpu.obs.device_time import PROGRAMS
+    from nds_tpu.obs.metrics import METRICS
+    from nds_tpu.obs.trace import TRACER
     from nds_tpu.power import gen_sql_from_stream, setup_tables
+
+    log = obs_log.configure(0 if args.quiet else None)
+    if args.trace:
+        TRACER.configure(enabled=True)
 
     wh_dir, stream_path = ensure_data()
     # measured configuration: EXACT scaled-int64 decimals (round-3 verdict
@@ -133,8 +166,8 @@ def main() -> None:
     units = [k for k in query_dict
              if k in QUERIES or k.rsplit("_part", 1)[0] in QUERIES]
     if not units:
-        print(f"FATAL: no stream query matches NDS_TPU_BENCH_QUERIES="
-              f"{','.join(QUERIES)!r}", file=sys.stderr)
+        log.error(f"FATAL: no stream query matches NDS_TPU_BENCH_QUERIES="
+                  f"{','.join(QUERIES)!r}")
         sys.exit(1)
 
     jax_ms: dict[str, float] = {}
@@ -142,36 +175,46 @@ def main() -> None:
     upload_bytes: dict[str, int] = {}
     exec_modes: dict[str, str] = {}
     fallback_reasons: dict[str, list] = {}
+    attribution: dict[str, float] = {}
     for name in units:
         sql = query_dict[name]
         # untimed oracle warm run: the first execution pays the lazy parquet
         # load of every touched table — IO both backends share via the
         # session cache, so it must not be billed to either side. The timed
         # number is best-of like the device side (symmetric methodology).
-        session.sql(sql, backend="numpy")
+        session.sql(sql, backend="numpy", label=name)
         best_np = float("inf")
         for _ in range(2):
             t0 = time.perf_counter()
-            session.sql(sql, backend="numpy")
+            session.sql(sql, backend="numpy", label=name)
             best_np = min(best_np, time.perf_counter() - t0)
         np_ms[name] = best_np * 1000
 
-        session.sql(sql, backend="jax")   # record (host) pass
-        session.sql(sql, backend="jax")   # compile + first device run
+        session.sql(sql, backend="jax", label=name)  # record (host) pass
+        session.sql(sql, backend="jax", label=name)  # compile + device run
         if session.last_fallbacks:
             # the per-operator REASON (last_exec_stats.fallback_reasons)
             # makes the remaining host-bound queries enumerable per run
             reasons = session.last_exec_stats.get(
                 "fallback_reasons", session.last_fallbacks)
-            print(f"FATAL: {name} fell back to host: {reasons}",
-                  file=sys.stderr)
+            log.error(f"FATAL: {name} fell back to host: {reasons}")
             sys.exit(1)
         best = float("inf")
+        wall_s = 0.0
+        prog_ms0 = PROGRAMS.total_ms()
         for _ in range(TIMED_RUNS):
             t0 = time.perf_counter()
-            session.sql(sql, backend="jax")
-            best = min(best, time.perf_counter() - t0)
+            session.sql(sql, backend="jax", label=name)
+            run_s = time.perf_counter() - t0
+            wall_s += run_s
+            best = min(best, run_s)
         jax_ms[name] = best * 1000
+        # fraction of the timed window the per-program device-time
+        # attribution explains (>=0.9 expected: everything outside
+        # CompiledQuery dispatch is python glue)
+        attribution[name] = round(
+            (PROGRAMS.total_ms() - prog_ms0) / (wall_s * 1000), 3) \
+            if wall_s > 0 else 0.0
         # streamed queries re-upload their morsels every run; in-core
         # queries upload nothing in steady state (device-resident scans)
         upload_bytes[name] = session.last_exec_stats.get("bytes_uploaded", 0)
@@ -179,18 +222,25 @@ def main() -> None:
         if session.last_exec_stats.get("fallback_reasons"):
             fallback_reasons[name] = \
                 list(session.last_exec_stats["fallback_reasons"])
-        print(f"{name}: device {jax_ms[name]:.1f} ms, "
-              f"oracle {np_ms[name]:.1f} ms, mode {exec_modes[name]}, "
-              f"upload {upload_bytes[name] / 1e6:.2f} MB", file=sys.stderr)
+        log.info(f"{name}: device {jax_ms[name]:.1f} ms, "
+                 f"oracle {np_ms[name]:.1f} ms, mode {exec_modes[name]}, "
+                 f"upload {upload_bytes[name] / 1e6:.2f} MB, "
+                 f"attribution {attribution[name]:.0%}")
 
     total_jax = sum(jax_ms.values())
     total_np = sum(np_ms.values())
     rows_scanned, bytes_scanned = scan_volume(session,
                                               [query_dict[u] for u in units])
     device_s = total_jax / 1000.0
-    bw = float(os.environ.get("NDS_TPU_BENCH_BW_GBPS", "100")) * 1e9
+    bw_gbps = float(os.environ.get("NDS_TPU_BENCH_BW_GBPS", "100"))
+    bw = bw_gbps * 1e9
     qtag = "+".join(u.replace("query", "q") for u in units)
-    print(json.dumps({
+    # per-program device-time attribution: the sorted top-programs table
+    # (per-program roofline fractions from cost_analysis bytes) replaces
+    # the single global roofline_frac as the kernel-work shopping list
+    device_time_programs = PROGRAMS.table(bw_gbps=bw_gbps, top=15)
+    out = {
+        "schema_version": 2,
         "metric": f"nds_power_{qtag}_sf{SCALE}_ms",
         "value": round(total_jax, 1),
         "unit": "ms",
@@ -209,7 +259,28 @@ def main() -> None:
         # the host — the per-run enumeration of non-device work
         "exec_modes": exec_modes,
         "fallback_reasons": fallback_reasons,
-    }))
+        # fraction of each query's timed wall the per-program device times
+        # explain (acceptance: >= 0.9)
+        "attribution_frac": attribution,
+        "device_time_programs": device_time_programs,
+        # uniform engine counters (obs.metrics): every layer writes through
+        # one registry, every report reads the same names
+        "metrics": METRICS.snapshot(),
+    }
+    if args.trace:
+        from nds_tpu.obs.device_time import format_table
+        trace_dir = args.trace_dir or BENCH_DIR
+        out["trace_file"] = TRACER.write_chrome_trace(
+            os.path.join(trace_dir, f"bench_trace_sf{SCALE}.json"))
+        out["trace_events"] = TRACER.write_jsonl(
+            os.path.join(trace_dir, f"bench_trace_sf{SCALE}.jsonl"))
+        # aggregated per-span table: the compact per-query view the trace
+        # file expands on (open trace_file in ui.perfetto.dev)
+        out["spans"] = TRACER.aggregate()
+        log.info("trace: %s (open in ui.perfetto.dev)", out["trace_file"])
+        log.info("top programs by device time:\n%s",
+                 format_table(device_time_programs))
+    print(json.dumps(out))
 
 
 def scan_volume(session, sqls: list[str]) -> tuple[int, int]:

@@ -12,11 +12,14 @@
 #                seeded-corruption mutation tests, tests/test_plan_verify.py)
 #   planner    - planner/streaming tier-1: late-materialization legality/
 #                differential, capacity-ladder, shared-scan morsel fusion,
-#                and narrow-lane packed-upload tests (fast, CPU backend):
-#                these rewrites change plans/execution (and the physical
-#                upload layout) for every dimension-grouped aggregate and
-#                every streamed query, so their SQLite-oracle exactness
-#                and bit-identity gates run early and cheaply
+#                narrow-lane packed-upload, and observability-layer tests
+#                (fast, CPU backend): these rewrites change plans/execution
+#                (and the physical upload layout) for every
+#                dimension-grouped aggregate and every streamed query, so
+#                their SQLite-oracle exactness and bit-identity gates run
+#                early and cheaply; the obs suite gates here because the
+#                tracer/metrics hooks thread through the same session/
+#                streaming paths
 #   test       - full pytest suite on an 8-virtual-device CPU mesh
 #   bench      - quick bench slice (SF 0.01) to catch perf regressions early
 #   all        - every stage in order
@@ -64,7 +67,8 @@ stage_static() {
 stage_planner() {
     (cd "$REPO" && python -m pytest tests/test_late_materialization.py \
         tests/test_capacity_ladder.py tests/test_shared_scan.py \
-        tests/test_streaming.py tests/test_narrow_lanes.py -q)
+        tests/test_streaming.py tests/test_narrow_lanes.py \
+        tests/test_obs.py -q)
 }
 
 stage_test() {
@@ -81,15 +85,25 @@ stage_bench() {
     rm -rf "$d"
 }
 
+# run one stage with wall-time accounting: every CI line ends with a
+# "stage <name>: <seconds>s" marker, so slow stages are attributable from
+# any runner's log without extra tooling
+run_stage() {
+    local name="$1"
+    local t0=$SECONDS
+    "stage_${name}"
+    echo "stage ${name}: $((SECONDS - t0))s"
+}
+
 case "${1:-all}" in
-    native)     stage_native ;;
-    resilience) stage_resilience ;;
-    static)     stage_static ;;
-    planner)    stage_planner ;;
-    test)       stage_test ;;
-    bench)      stage_bench ;;
-    all)        stage_native; stage_resilience; stage_static; stage_planner
-                stage_test; stage_bench ;;
+    native|resilience|static|planner|test|bench)
+        run_stage "$1" ;;
+    all)
+        total0=$SECONDS
+        for s in native resilience static planner test bench; do
+            run_stage "$s"
+        done
+        echo "stage all: $((SECONDS - total0))s" ;;
     --list)     echo "native resilience static planner test bench all" ;;
     *) echo "usage: run_ci.sh [native|resilience|static|planner|test|bench|all|--list]" >&2
        exit 2 ;;

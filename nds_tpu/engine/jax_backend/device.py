@@ -143,10 +143,17 @@ jax.tree_util.register_pytree_node(DTable, _dtable_flatten, _dtable_unflatten)
 
 def to_device(table: Table, capacity: Optional[int] = None,
               device=None) -> DTable:
+    from ...obs.trace import TRACER
     from ...resilience import FAULTS
     FAULTS.fire("device.put")
     n = table.num_rows
     cap = capacity if capacity is not None else bucket(n)
+    with TRACER.span("upload", cat="upload", rows=n,
+                     cols=len(table.columns), capacity=cap):
+        return _to_device(table, n, cap, device)
+
+
+def _to_device(table: Table, n: int, cap: int, device) -> DTable:
 
     def put(arr):
         return jnp.asarray(arr) if device is None \
@@ -378,6 +385,14 @@ def pack_table(table: Table, capacity: Optional[int] = None,
                          "columns")
     n = table.num_rows
     cap = capacity if capacity is not None else bucket(n)
+    from ...obs.trace import TRACER
+    with TRACER.span("lane.pack", cat="upload", rows=n,
+                     cols=len(table.columns), capacity=cap):
+        return _pack_table(table, lanes, n, cap)
+
+
+def _pack_table(table: Table, lanes: tuple, n: int,
+                cap: int) -> PackedTable:
     parts: list[np.ndarray] = []
     vparts: list[np.ndarray] = []
     dicts = []

@@ -22,6 +22,7 @@ backend for that node only (eager mode; such plans are never compiled).
 from __future__ import annotations
 
 import dataclasses
+import os
 import threading
 from typing import Callable, Optional
 
@@ -30,6 +31,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ...obs import metrics as _metrics
+from ...obs.device_time import PROGRAMS as _PROGRAMS
+from ...obs.trace import TRACER
 from ..column import Table, dec_scale, is_dec
 from ..executor import Executor as HostExecutor
 from ..plan import (
@@ -66,6 +70,11 @@ class ArgSpecMismatch(ValueError):
 _NOJIT_ERRORS = (NotJittable, NotImplementedError,
                  jax.errors.TracerArrayConversionError,
                  jax.errors.ConcretizationTypeError)
+
+#: force cost_analysis capture on the jit (no-AOT) path even without
+#: tracing — one extra lower+compile per program, on its first sighting
+_COST_ANALYSIS = os.environ.get(
+    "NDS_TPU_COST_ANALYSIS", "").lower() in ("1", "true", "yes", "on")
 
 
 class _Recorder:
@@ -128,17 +137,22 @@ class CompiledQuery:
 
     def __init__(self, plan, decisions: list, scan_keys: tuple,
                  mesh=None, param_dtypes: tuple = (),
-                 shard_min_rows: int = 1 << 18):
+                 shard_min_rows: int = 1 << 18, label: str = ""):
         self.plan = plan
         self.decisions = decisions
         self.scan_keys = scan_keys
         self.mesh = mesh
         self.param_dtypes = param_dtypes
         self.shard_min_rows = shard_min_rows
+        # device-time attribution key (obs.device_time): "<query>/<unit>";
+        # every run's measured dispatch wall accumulates under it, and the
+        # jax.profiler annotation carries it into hardware profiles
+        self.label = label or "program"
         self._fn = None
         self._aot = None     # AOT executable from precompile()
         self._aot_specs = None  # flat (shape, dtype) list the AOT was lowered for
         self._aot_arg_specs = None  # per-argument [(label, specs)] for reports
+        self._cost_recorded = False  # cost_analysis captured once per program
         # _SHARED_PROGRAMS hands one CompiledQuery to every stream of a
         # template: concurrent multi-stream runs must not race the lazy
         # _fn/_aot initialization (ADVICE r5)
@@ -210,13 +224,28 @@ class CompiledQuery:
         params = tuple(jax.ShapeDtypeStruct((), phys_dtype(d))
                        for d in self.param_dtypes)
         t0 = _time.perf_counter()
-        aot = fn.lower(scan_specs, params).compile()
+        with TRACER.span("compile", cat="compile", label=self.label):
+            aot = fn.lower(scan_specs, params).compile()
+        _metrics.COMPILES.inc()
+        self._record_cost(aot)
         with self._lock:
             self._aot = aot
             self._aot_specs = self._flat_specs((scan_specs, params))
             self._aot_arg_specs = self._arg_spec_table(scan_specs, params)
         if stats is not None:
             stats["precompile_s"] = round(_time.perf_counter() - t0, 3)
+
+    def _record_cost(self, compiled) -> None:
+        """Attach the program's static cost_analysis() FLOPs/bytes to the
+        device-time registry ONCE — the per-program roofline denominator.
+        Best-effort: cost data enriches attribution, never fails a run."""
+        if self._cost_recorded:
+            return
+        try:
+            _PROGRAMS.record_cost(self.label, compiled.cost_analysis())
+            self._cost_recorded = True
+        except Exception:
+            self._cost_recorded = True   # unsupported backend: don't retry
 
     @staticmethod
     def _flat_specs(tree) -> Optional[list]:
@@ -305,56 +334,77 @@ class CompiledQuery:
                 FAULTS.fire("jax.compile")
                 self._fn = jax.jit(self._trace)
             fn, aot = self._fn, self._aot
+        if first:
+            _metrics.COMPILES.inc()   # jit path compiles inside the call
         FAULTS.fire("jax.execute")
-        t1 = _time.perf_counter()
-        args = self._args(scans, values)
-        if aot is not None and not self._specs_match(args):
-            # shape/dtype drift against the precompiled specs: take the jit
-            # path explicitly (the persistent compile cache still serves the
-            # binary when the lowering matches) instead of letting the AOT
-            # call fail and masking the error class. The per-argument
-            # expected-vs-got report lands in stats so the drift is
-            # attributable to a specific scan/param, not a bare mismatch.
-            if stats is not None:
-                report = self.spec_mismatch_report(scans, values)
-                if report:
-                    stats["spec_mismatch"] = report
-            with self._lock:
-                if self._aot is aot:
-                    self._aot = None
-            aot = None
-        if aot is not None:
-            try:
-                out, checks = aot(*args)
-            except (TypeError, ValueError) as aot_err:
-                # drift the shape check cannot see (committed-device /
-                # sharding mismatch). Retry via jit once; a jit failure of
-                # the SAME class is a genuine runtime error — re-raise it
-                # with the AOT error as explicit context instead of
-                # swallowing the original.
+        # attribution boundary (the Flare lesson): the compiled-program
+        # dispatch is the unit device time is measured at; the jax.profiler
+        # annotation carries the same label into hardware profiles
+        with TRACER.span("exec", cat="device", label=self.label,
+                         first=first):
+            t1 = _time.perf_counter()
+            args = self._args(scans, values)
+            if aot is not None and not self._specs_match(args):
+                # shape/dtype drift against the precompiled specs: take the
+                # jit path explicitly (the persistent compile cache still
+                # serves the binary when the lowering matches) instead of
+                # letting the AOT call fail and masking the error class.
+                # The per-argument expected-vs-got report lands in stats so
+                # the drift is attributable to a specific scan/param, not a
+                # bare mismatch.
+                if stats is not None:
+                    report = self.spec_mismatch_report(scans, values)
+                    if report:
+                        stats["spec_mismatch"] = report
                 with self._lock:
                     if self._aot is aot:
                         self._aot = None
-                try:
+                aot = None
+            with jax.profiler.TraceAnnotation(self.label):
+                if aot is not None:
+                    try:
+                        out, checks = aot(*args)
+                    except (TypeError, ValueError) as aot_err:
+                        # drift the shape check cannot see (committed-device
+                        # / sharding mismatch). Retry via jit once; a jit
+                        # failure of the SAME class is a genuine runtime
+                        # error — re-raise it with the AOT error as explicit
+                        # context instead of swallowing the original.
+                        with self._lock:
+                            if self._aot is aot:
+                                self._aot = None
+                        try:
+                            out, checks = fn(*args)
+                        except type(aot_err):
+                            raise aot_err
+                else:
                     out, checks = fn(*args)
-                except type(aot_err):
-                    raise aot_err
-        else:
-            out, checks = fn(*args)
-        # ONE device_get for result + checks: tunneled platforms charge a
-        # fixed RTT per transfer, so piecemeal np.asarray would dominate.
-        # keep_device (segment outputs feeding downstream programs): only
-        # the check scalars come back.
-        if keep_device:
-            checks_host = jax.device_get(checks)
-            out_host = out
-        else:
-            out_host, checks_host = jax.device_get((out, checks))
-        t2 = _time.perf_counter()
+                # ONE device_get for result + checks: tunneled platforms
+                # charge a fixed RTT per transfer, so piecemeal np.asarray
+                # would dominate. keep_device (segment outputs feeding
+                # downstream programs): only the check scalars come back.
+                if keep_device:
+                    checks_host = jax.device_get(checks)
+                    out_host = out
+                else:
+                    out_host, checks_host = jax.device_get((out, checks))
+            t2 = _time.perf_counter()
         _verify_schedule(self.decisions, checks_host)
+        device_ms = round((t2 - t1) * 1000, 3)
+        _PROGRAMS.record_run(self.label, device_ms, first=first)
+        if aot is not None:
+            self._record_cost(aot)      # cheap: executable already built
+        elif first and (TRACER.enabled or _COST_ANALYSIS):
+            # jit path keeps no public handle on its executable: re-lower
+            # once (host-side, paid on the untimed compile+run sighting
+            # only, and only when attribution is wanted) to pull FLOPs/bytes
+            try:
+                self._record_cost(fn.lower(*args).compile())
+            except Exception:
+                self._cost_recorded = True
         if stats is not None:
             stats.update(mode="compile+run" if first else "compiled",
-                         device_ms=round((t2 - t1) * 1000, 3))
+                         device_ms=device_ms)
         return out_host
 
 
@@ -396,6 +446,10 @@ class JaxExecutor:
         self._touched_scans: dict[str, None] = {}   # ordered set (first touch)
         self._scan_meta: dict[str, tuple] = {}   # key -> (table, cols, names)
         self.fallback_nodes: list[str] = []   # observability: who fell back
+        # label of the in-flight query (Session.sql sets it); compile units
+        # recorded during the run inherit "<label>/<unit>" program labels
+        # for device-time attribution
+        self.query_label: str = ""
         # SPMD execution: with a mesh, fact-sized scans upload row-sharded
         # (NamedSharding over the first axis); GSPMD partitions the compiled
         # whole-plan program and inserts the collectives (the Spark-shuffle
@@ -640,6 +694,19 @@ class JaxExecutor:
             if self._scan_cache_rec is not self._scan_cache:
                 self._scan_cache_rec.pop(old, None)
 
+    def _unit_label(self, key) -> str:
+        """Attribution label for a compile unit: "<query>/<unit>" — the key
+        the device-time registry ranks programs by (segments keep a short
+        fingerprint so q14/q23-style shared CTEs stay distinguishable)."""
+        base = self.query_label or "query"
+        if isinstance(key, tuple) and len(key) == 2 and \
+                isinstance(key[1], str):
+            if key[1].startswith("seg:"):
+                return f"{base}/{key[1][:12]}"
+            if key[1] == "root":
+                return f"{base}/root"
+        return base
+
     def _run_unit(self, key, plan, keep_device: bool = False) -> DTable:
         """One compile unit through the record -> compile -> replay
         lifecycle (the pre-segmentation run_query body)."""
@@ -647,6 +714,7 @@ class JaxExecutor:
         plan_factory = plan if callable(plan) else (lambda: plan)
         ent = self._plans.get(key) if key is not None else None
         if ent is not None:
+            _metrics.PROGRAM_CACHE_HITS.inc()
             if ent["cq"] is not None:                  # steady state
                 try:
                     out = self._run_compiled(ent["cq"], ent, keep_device)
@@ -662,6 +730,7 @@ class JaxExecutor:
                     self.last_stats["nojit_reason"] = ent["nojit_reason"]
                     return self._eager_ent(ent)
                 except ReplayMismatch:
+                    _metrics.REPLAY_MISMATCHES.inc()
                     self._fp_block = ent.get("fp")
                     self._plans.pop(key, None)
                     ent = None
@@ -684,7 +753,9 @@ class JaxExecutor:
                 cq = CompiledQuery(ent["plan"], ent["decisions"],
                                    ent["scan_keys"], mesh=self._mesh,
                                    param_dtypes=ent.get("param_dtypes", ()),
-                                   shard_min_rows=self._shard_min_rows)
+                                   shard_min_rows=self._shard_min_rows,
+                                   label=ent.get("label",
+                                                 self._unit_label(key)))
                 try:
                     out = self._run_compiled(cq, ent, keep_device)
                     ent["cq"] = cq
@@ -698,6 +769,7 @@ class JaxExecutor:
                     self.last_stats["nojit_reason"] = ent["nojit_reason"]
                     return self._eager_ent(ent)
                 except ReplayMismatch:
+                    _metrics.REPLAY_MISMATCHES.inc()
                     self._fp_block = ent.get("fp")
                     self._plans.pop(key, None)
                     ent = None
@@ -711,6 +783,7 @@ class JaxExecutor:
                                            transient=f"{e}"[:200])
                     return self._eager_ent(ent)
         # first sighting (or invalidated): eager run, recording the schedule
+        _metrics.PROGRAM_CACHE_MISSES.inc()
         plan = plan_factory()
         fp = None
         if key is not None and self._jit_plans:
@@ -718,18 +791,21 @@ class JaxExecutor:
             fp = self._shared_fp(pplan)
             if self._adopt_shared(key, fp, tuple(pvalues), tuple(pdtypes)):
                 self.last_stats["mode"] = "adopted"
+                _metrics.PROGRAMS_ADOPTED.inc()
                 return self._run_unit(key, plan, keep_device)
         else:       # uncached one-shot: skip the rewrite, nothing reuses it
             pplan, pvalues, pdtypes = plan, [], []
         self.last_stats["mode"] = "record"
-        out, decisions, scan_keys = self.record_plan(pplan, tuple(pvalues))
+        with TRACER.span("record", label=self._unit_label(key)):
+            out, decisions, scan_keys = self.record_plan(pplan,
+                                                         tuple(pvalues))
         if key is not None and self._jit_plans:
             ent = {
                 "plan": pplan, "decisions": decisions,
                 "scan_keys": scan_keys,
                 "params": tuple(pvalues), "param_dtypes": tuple(pdtypes),
                 "cq": None, "nojit": len(self.fallback_nodes) > fb0,
-                "fp": fp}
+                "fp": fp, "label": self._unit_label(key)}
             self._publish_recorded(ent)
             self._plans[key] = ent
             self._fp_block = None
@@ -871,7 +947,8 @@ class JaxExecutor:
             cq = CompiledQuery(ent["plan"], ent["decisions"],
                                ent["scan_keys"], mesh=self._mesh,
                                param_dtypes=ent.get("param_dtypes", ()),
-                               shard_min_rows=self._shard_min_rows)
+                               shard_min_rows=self._shard_min_rows,
+                               label=ent.get("label", self._unit_label(k)))
             todo.append((k, ent, cq, specs))
         if not todo:
             return {}

@@ -62,9 +62,12 @@ class PassPipeline:
         self._snap: Optional[dict] = None
 
     def _verify(self, plan, pass_name: str, deep: bool = False) -> None:
+        from ..obs.trace import TRACER
         from .verify import PlanVerifyError, node_labels, verify_plan
-        labels = node_labels(plan)
-        findings = verify_plan(plan, self.catalog, deep=deep, labels=labels)
+        with TRACER.span("plan.verify", **{"pass": pass_name}):
+            labels = node_labels(plan)
+            findings = verify_plan(plan, self.catalog, deep=deep,
+                                   labels=labels)
         if findings:
             raise PlanVerifyError(findings, pass_name)
 
@@ -78,13 +81,18 @@ class PassPipeline:
 
     def run(self, pass_name: str, fn, plan):
         """Run one rewrite pass; in per-pass mode, prove surviving nodes
-        are structurally frozen and the output plan verifies clean."""
+        are structurally frozen and the output plan verifies clean. Every
+        pass (and its verification, via _verify) is a traced span, so a
+        Perfetto view of planning shows per-pass cost."""
+        from ..obs.trace import TRACER
         if self.mode != "per-pass":
-            return fn(plan)
+            with TRACER.span("plan.pass", **{"pass": pass_name}):
+                return fn(plan)
         from .verify import PlanVerifyError, frozen_scan, verify_plan
         before = self._snap if self._snap is not None else \
             frozen_scan(plan, None)[1]
-        out = fn(plan)
+        with TRACER.span("plan.pass", **{"pass": pass_name}):
+            out = fn(plan)
         findings, after = frozen_scan(out, before)
         if findings:
             raise PlanVerifyError(findings, pass_name)

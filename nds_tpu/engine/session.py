@@ -14,6 +14,9 @@ import pyarrow as pa
 import pyarrow.dataset as pa_dataset
 
 from ..config import EngineConfig
+from ..obs import metrics as _metrics
+from ..obs.stats import ExecStats
+from ..obs.trace import TRACER
 from ..sql import parse_sql
 from .column import Table
 from .executor import Executor
@@ -76,8 +79,15 @@ class Session:
         self._col_stats: dict[str, dict] = {}
         # device-backend fallback observability, reset per sql() call
         self.last_fallbacks: list[str] = []
-        # execution-mode/timing observability for the last sql() call
+        # execution-mode/timing observability for the last sql() call:
+        # last_exec_stats is the backward-compatible DICT VIEW of the typed
+        # record in last_exec_stats_typed — both are installed by the single
+        # builder _finish_exec_stats (obs.stats.ExecStats)
         self.last_exec_stats: dict = {}
+        self.last_exec_stats_typed: Optional[ExecStats] = None
+        # label of the in-flight sql() call (runners pass the query name);
+        # compiled programs inherit it for device-time attribution
+        self._active_label: str = ""
         # catalog generation: bumped on any (re-)registration so the device
         # executor's scan cache and compiled plans never serve stale data
         self._generation = 0
@@ -371,39 +381,75 @@ class Session:
                        verify_plans=self.config.verify_plans,
                        stats_source=self.column_stats)
 
-    def sql(self, query: str, backend: Optional[str] = None) -> Table:
+    def sql(self, query: str, backend: Optional[str] = None,
+            label: Optional[str] = None) -> Table:
         """Run a query; backend "jax" (device) or "numpy" (host oracle).
 
         Defaults to the config's use_jax flag — the device path is the
         product path, the numpy path is the differential-validation oracle
         (the role CPU-Spark plays against GPU-Spark in the reference,
         nds/nds_validate.py).
+
+        label: human-stable query name for observability (runners pass
+        "query9" etc.); spans and per-program device-time attribution key
+        on it. Defaults to a short content hash of the SQL text.
         """
         use_jax = (backend == "jax") if backend else self.config.use_jax
         self.last_fallbacks = []
-        if use_jax:
-            from .jax_backend import to_host
-            if self.config.out_of_core:
-                result = self._sql_streaming(query)
-                if result is not None:
-                    return result
-            jexec = self._jax_executor()
+        self._active_label = label or self._auto_label(query)
+        _metrics.QUERIES_RUN.inc()
+        with TRACER.span("query", label=self._active_label,
+                         backend="jax" if use_jax else "numpy"):
+            if use_jax:
+                from .jax_backend import to_host
+                if self.config.out_of_core:
+                    result = self._sql_streaming(query)
+                    if result is not None:
+                        return result
+                jexec = self._jax_executor()
+                jexec.query_label = self._active_label
 
-            def factory():
-                return Planner(self._catalog()).plan_query(parse_sql(query))
-            result = to_host(jexec.run_query(("sql", query), factory))
-            self.last_fallbacks = list(jexec.fallback_nodes)
-            self.last_exec_stats = dict(jexec.last_stats)
-            if self.last_fallbacks:
+                def factory():
+                    with TRACER.span("plan", label=self._active_label):
+                        with TRACER.span("parse"):
+                            ast = parse_sql(query)
+                        return Planner(self._catalog()).plan_query(ast)
+                result = to_host(jexec.run_query(("sql", query), factory))
+                self.last_fallbacks = list(jexec.fallback_nodes)
                 # the REASON a query is not fully on-device (operator + why)
                 # rides the stats so runners can enumerate the remaining
                 # host/in-core queries per run without scraping status text
-                self.last_exec_stats["fallback_reasons"] = \
-                    list(self.last_fallbacks)
-            return result
-        plan = Planner(self._catalog()).plan_query(parse_sql(query))
-        executor = Executor(self.load_table)
-        return executor.execute(plan)
+                self._finish_exec_stats(ExecStats.from_executor(
+                    jexec.last_stats, self.last_fallbacks))
+                return result
+            with TRACER.span("plan", label=self._active_label):
+                plan = Planner(self._catalog()).plan_query(parse_sql(query))
+            executor = Executor(self.load_table)
+            return executor.execute(plan)
+
+    @staticmethod
+    def _auto_label(query: str) -> str:
+        import hashlib
+        return "q" + hashlib.sha1(query.encode()).hexdigest()[:8]
+
+    def _finish_exec_stats(self, stats: ExecStats) -> None:
+        """THE single point where a query's execution stats land (both the
+        in-core executor path and the streaming path build an ExecStats and
+        come through here): installs the typed record, its backward-
+        compatible dict view, and rolls the run into the process-wide
+        metrics registry."""
+        self.last_exec_stats_typed = stats
+        self.last_exec_stats = stats.to_dict()
+        if stats.fallback_reasons:
+            _metrics.HOST_FALLBACKS.inc(len(stats.fallback_reasons))
+        if stats.prefetch_error_details:
+            _metrics.PREFETCH_ERRORS.inc(len(stats.prefetch_error_details))
+        if stats.scan_passes:
+            _metrics.SCAN_PASSES.inc(stats.scan_passes)
+        if stats.morsels:
+            _metrics.MORSELS.inc(stats.morsels)
+        if stats.bytes_uploaded:
+            _metrics.BYTES_UPLOADED.inc(stats.bytes_uploaded)
 
     def _stream_config_key(self) -> tuple:
         """Streaming-state cache validity fingerprint: the cached rewritten
@@ -516,15 +562,18 @@ class Session:
             if not partials[ji]:
                 self._stream_cache[query] = None
                 return None
-            merged_arrow = pa.concat_tables(partials[ji],
-                                            promote_options="permissive")
-            merged = arrow_bridge.from_arrow(merged_arrow,
-                                             self._dec_as_int())
-            mat = MaterializedNode(table=merged, label="streamed-partials",
-                                   out_names=list(job.partial_names),
-                                   out_dtypes=list(job.partial_dtypes))
-            final_sub = job.build_final(mat)
-            sub_res = Executor(self.load_table).execute(final_sub)
+            with TRACER.span("merge.partials", job=ji,
+                             parts=len(partials[ji])):
+                merged_arrow = pa.concat_tables(partials[ji],
+                                                promote_options="permissive")
+                merged = arrow_bridge.from_arrow(merged_arrow,
+                                                 self._dec_as_int())
+                mat = MaterializedNode(table=merged,
+                                       label="streamed-partials",
+                                       out_names=list(job.partial_names),
+                                       out_dtypes=list(job.partial_dtypes))
+                final_sub = job.build_final(mat)
+                sub_res = Executor(self.load_table).execute(final_sub)
             mat_node = MaterializedNode(
                 table=sub_res, label="streamed-agg",
                 out_names=list(job.agg.out_names),
@@ -539,34 +588,32 @@ class Session:
             else:
                 mapping[id(job.agg)] = mat_node
         final_plan = streaming.substitute_nodes(plan, mapping)
-        result = Executor(self.load_table).execute(final_plan)
-        self.last_exec_stats = {
-            "mode": "streaming",
-            "jobs": len(jobs),
-            "morsels": total_morsels,
-            "morsel_rows": self.config.chunk_rows,
-            "re_records": re_records,
-            # shared-scan observability (round 7): scan_passes counts morsel
-            # loops (== tables_streamed when shared_scan serves every branch
-            # from one pass; == branches_served per-branch without it)
-            "shared_scan": bool(self.config.shared_scan),
-            "scan_passes": len(groups),
-            "tables_streamed": len(morsels_per_table),
-            "branches_served": sum(len(g.members) for g in groups),
-            "fused_groups": fused_groups,
-            "bytes_uploaded": bytes_uploaded,
-            "morsels_per_table": morsels_per_table,
-            # narrow-lane packing observability: which physical lane each
-            # streamed column rode (bytes_uploaded above measures the win)
-            "narrow_lanes": bool(self.config.narrow_lanes),
-            "lane_spec": {g.table: dict(zip(g.columns, g.lanes))
-                          for g in groups if g.lanes is not None},
-        }
-        if prefetch_errs:
-            # prefetch failures degrade to synchronous staging — correct but
-            # slower; surface them so the degradation is observable
-            self.last_exec_stats["prefetch_errors"] = len(prefetch_errs)
-            self.last_exec_stats["prefetch_error"] = prefetch_errs[0]
+        with TRACER.span("finalize", label=self._active_label,
+                         jobs=len(jobs)):
+            result = Executor(self.load_table).execute(final_plan)
+        # scan_passes counts morsel loops (== tables_streamed when
+        # shared_scan serves every branch from one pass; == branches_served
+        # per-branch without it); lane_spec records which physical lane each
+        # streamed column rode (bytes_uploaded measures the win); EVERY
+        # prefetch failure is recorded — they degrade to synchronous staging,
+        # correct but slower, so the degradation must be observable
+        self._finish_exec_stats(ExecStats.streaming(
+            jobs=len(jobs),
+            morsels=total_morsels,
+            morsel_rows=self.config.chunk_rows,
+            re_records=re_records,
+            shared_scan=bool(self.config.shared_scan),
+            scan_passes=len(groups),
+            tables_streamed=len(morsels_per_table),
+            branches_served=sum(len(g.members) for g in groups),
+            fused_groups=fused_groups,
+            bytes_uploaded=bytes_uploaded,
+            morsels_per_table=morsels_per_table,
+            narrow_lanes=bool(self.config.narrow_lanes),
+            lane_spec={g.table: dict(zip(g.columns, g.lanes))
+                       for g in groups if g.lanes is not None},
+            prefetch_error_details=prefetch_errs,
+            fallbacks=self.last_fallbacks))
         return result
 
     def _new_stream_executor(self) -> dict:
@@ -669,14 +716,15 @@ class Session:
                 state["cqs"] = [CompiledQuery(
                     list(group.plans), decisions, scan_keys,
                     mesh=jexec._mesh,
-                    shard_min_rows=jexec._shard_min_rows)]
+                    shard_min_rows=jexec._shard_min_rows,
+                    label=f"{self._active_label}/morsel:{group.table}")]
                 state["ents"] = [{"scan_keys": scan_keys}]
             else:
                 # fusion over budget (or single member): per-member
                 # programs, each with its own schedule, all resolving the
                 # shared staged buffer through the same morsel scan key
                 cqs, ents = [], []
-                for p in group.plans:
+                for bi, p in enumerate(group.plans):
                     _out, decisions, scan_keys = jexec.record_plan(p)
                     if jexec.fallback_nodes:
                         return False
@@ -684,7 +732,9 @@ class Session:
                                                            morsel_rows)
                     cqs.append(CompiledQuery(
                         p, decisions, scan_keys, mesh=jexec._mesh,
-                        shard_min_rows=jexec._shard_min_rows))
+                        shard_min_rows=jexec._shard_min_rows,
+                        label=f"{self._active_label}/morsel:"
+                              f"{group.table}#{bi}"))
                     ents.append({"scan_keys": scan_keys})
                 state["cqs"], state["ents"] = cqs, ents
             state["fused"] = fuse
@@ -694,10 +744,12 @@ class Session:
             """Pack + upload one union-column morsel into a fresh buffer
             (group.lanes = the static narrow-lane spec; None = legacy wide
             layout under --no_narrow_lanes)."""
-            sub = morsel.select(group.columns)
-            packed = pack_table(sub, capacity=cap, lanes=group.lanes)
-            return packed if packed is not None else \
-                to_device(sub, capacity=cap)
+            with TRACER.span("morsel.stage", cat="upload",
+                             table=group.table, rows=morsel.num_rows):
+                sub = morsel.select(group.columns)
+                packed = pack_table(sub, capacity=cap, lanes=group.lanes)
+                return packed if packed is not None else \
+                    to_device(sub, capacity=cap)
 
         def run_members():
             """Every member program against the staged buffer: one fused
@@ -746,11 +798,15 @@ class Session:
                             staged["err"] = e
                     stage_thread = threading.Thread(target=work, daemon=True)
                     stage_thread.start()
-                bytes_uploaded += device_bytes(buf)
+                buf_bytes = device_bytes(buf)
+                bytes_uploaded += buf_bytes
                 prev = jexec._scan_cache.get(mkey)
                 jexec._scan_cache[mkey] = buf
                 current["table"] = morsel
-                outs = run_members()
+                with TRACER.span("morsel.exec", cat="device",
+                                 table=group.table, morsel=count,
+                                 rows=morsel.num_rows, bytes=buf_bytes):
+                    outs = run_members()
                 free_dtable(prev)
                 for (job, plist), out in zip(sinks, outs):
                     plist.append(arrow_bridge.to_arrow(to_host(out)))

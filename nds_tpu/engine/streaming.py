@@ -552,6 +552,8 @@ def plan_scan_groups(jobs: list[StreamJob], shared: bool) -> list[ScanGroup]:
     per branch otherwise (the pre-round-7 behavior, kept reachable for A/B
     via shared_scan=False / --no_shared_scan). Branch order inside a group
     is (job, branch) order, so partial-merge order is deterministic."""
+    from ..obs.trace import TRACER
+
     keyed: dict = {}
     order: list = []
     for ji, job in enumerate(jobs):
@@ -564,11 +566,14 @@ def plan_scan_groups(jobs: list[StreamJob], shared: bool) -> list[ScanGroup]:
                 order.append(key)
             keyed[key].append((ji, bi, b))
     groups = []
-    for key in order:
-        members = keyed[key]
-        cols, dtypes, plans = fuse_group([b for _, _, b in members])
-        groups.append(ScanGroup(members[0][2].big_table, cols, dtypes,
-                                [(ji, bi) for ji, bi, _ in members], plans))
+    with TRACER.span("stream.plan_groups", shared=shared,
+                     branches=sum(len(m) for m in keyed.values())):
+        for key in order:
+            members = keyed[key]
+            cols, dtypes, plans = fuse_group([b for _, _, b in members])
+            groups.append(ScanGroup(members[0][2].big_table, cols, dtypes,
+                                    [(ji, bi) for ji, bi, _ in members],
+                                    plans))
     return groups
 
 
@@ -584,6 +589,13 @@ def verify_groups(groups: list[ScanGroup], col_stats=None) -> None:
     EngineConfig.verify_plans == "per-pass" (the groups never flow through
     planner.PassPipeline); raises PlanVerifyError naming the group/member
     as the offending pass."""
+    from ..obs.trace import TRACER
+
+    with TRACER.span("stream.verify_groups", groups=len(groups)):
+        return _verify_groups(groups, col_stats)
+
+
+def _verify_groups(groups: list[ScanGroup], col_stats=None) -> None:
     from .verify import PlanVerifyError, check_scan_lanes, verify_plan
 
     for gi, g in enumerate(groups):

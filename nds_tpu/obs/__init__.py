@@ -1,0 +1,23 @@
+"""Observability layer: span tracing, metrics, device-time attribution.
+
+Built BEFORE the kernel/sharding work (ROADMAP items 1-2) because the
+engine could not say which operator in which query burns the chip's time
+— this package is the instrument those PRs are measured with.
+
+- :mod:`.trace`   — lifecycle span tracer (parse -> plan passes ->
+  compile -> upload -> per-morsel exec -> finalize) with Chrome-trace /
+  JSONL / aggregate exporters; near-zero cost disabled.
+- :mod:`.metrics` — process-wide typed counter/gauge registry every layer
+  writes through; snapshots embed in bench/power JSON.
+- :mod:`.device_time` — per-compiled-program measured device time +
+  cost_analysis FLOPs/bytes, ranked with per-program roofline fractions.
+- :mod:`.stats`   — the typed ``ExecStats`` replacing the untyped
+  ``last_exec_stats`` dict (dict view preserved).
+- :mod:`.log`     — ``logging``-based diagnostics channel with one
+  verbosity knob, replacing raw stderr writes.
+"""
+from .trace import TRACER, span                                  # noqa: F401
+from .metrics import METRICS                                     # noqa: F401
+from .device_time import PROGRAMS                                # noqa: F401
+from .stats import ExecStats                                     # noqa: F401
+from .log import get_logger                                      # noqa: F401

@@ -1,0 +1,170 @@
+"""Unified metrics registry: typed counters/gauges for the whole engine.
+
+Before this module every layer grew its own ad-hoc numbers — bench JSON
+keys per PR, ``last_exec_stats`` dict entries, stderr one-liners. One
+registry gives every layer (session, device, executor, streaming,
+resilience, throughput, runners) a single place to write and every report
+a single place to read: ``METRICS.snapshot()`` lands verbatim in
+``bench.py`` / ``power.py`` JSON and ``scripts/trace_report.py``.
+
+Counters are monotonic per process; runners take a snapshot before a unit
+of work and report the ``delta`` so per-query/per-phase numbers come out
+of process-lifetime totals. Everything is lock-protected — staging
+threads, deadline workers, and compile pools all write concurrently.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Union
+
+Number = Union[int, float]
+
+
+class Counter:
+    """Monotonic counter. ``inc`` only; never reset outside tests."""
+    __slots__ = ("name", "help", "_value", "_lock")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._value: Number = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: Number = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> Number:
+        with self._lock:
+            return self._value
+
+    def _reset(self) -> None:
+        with self._lock:
+            self._value = 0
+
+
+class Gauge:
+    """Last-written value (queue depths, in-flight counts)."""
+    __slots__ = ("name", "help", "_value", "_lock")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._value: Number = 0
+        self._lock = threading.Lock()
+
+    def set(self, v: Number) -> None:
+        with self._lock:
+            self._value = v
+
+    def add(self, n: Number = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> Number:
+        with self._lock:
+            return self._value
+
+    def _reset(self) -> None:
+        with self._lock:
+            self._value = 0
+
+
+class MetricsRegistry:
+    """Named metric store; get-or-create semantics so layers never race
+    over registration order."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: dict[str, Union[Counter, Gauge]] = {}
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Counter(name, help)
+                self._metrics[name] = m
+            elif not isinstance(m, Counter):
+                raise TypeError(f"metric {name!r} is a {type(m).__name__}")
+            return m
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Gauge(name, help)
+                self._metrics[name] = m
+            elif not isinstance(m, Gauge):
+                raise TypeError(f"metric {name!r} is a {type(m).__name__}")
+            return m
+
+    def snapshot(self) -> dict[str, Number]:
+        """{name: value} for every registered metric — the uniform block
+        runners embed in their JSON output."""
+        with self._lock:
+            items = list(self._metrics.items())
+        return {name: m.value for name, m in sorted(items)}
+
+    def delta(self, before: dict[str, Number]) -> dict[str, Number]:
+        """Per-unit-of-work view: current snapshot minus ``before``,
+        dropping zero rows (counters are process-lifetime totals)."""
+        now = self.snapshot()
+        out = {}
+        for name, v in now.items():
+            d = v - before.get(name, 0)
+            if d:
+                out[name] = round(d, 3) if isinstance(d, float) else d
+        return out
+
+    def describe(self) -> dict[str, str]:
+        """{name: help} metrics glossary (README / trace_report)."""
+        with self._lock:
+            return {name: m.help for name, m in sorted(self._metrics.items())}
+
+    def reset(self) -> None:
+        """Zero every metric (tests only; counters are monotonic in
+        production)."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            m._reset()
+
+
+#: the process-global registry; every engine layer writes through it.
+METRICS = MetricsRegistry()
+
+# Pre-registered engine metrics: importing a layer must not be required
+# before its counters appear in snapshots, and attribute-style access
+# (``from ..obs.metrics import QUERIES_RUN``) is typo-safe at import time.
+QUERIES_RUN = METRICS.counter(
+    "queries_run", "sql() calls executed by any Session")
+QUERY_FAILURES = METRICS.counter(
+    "query_failures", "timed query runs that raised (power runner)")
+RETRIES = METRICS.counter(
+    "retries", "retry attempts consumed by any RetryPolicy/BenchReport")
+FAULT_FIRINGS = METRICS.counter(
+    "fault_point_firings", "armed fault specs triggered (FaultRegistry)")
+PROGRAM_CACHE_HITS = METRICS.counter(
+    "program_cache_hits", "compiled/recorded plan entries served from cache")
+PROGRAM_CACHE_MISSES = METRICS.counter(
+    "program_cache_misses", "plan entries recorded fresh (first sighting)")
+PROGRAMS_ADOPTED = METRICS.counter(
+    "programs_adopted", "cross-stream shared-program adoptions")
+COMPILES = METRICS.counter(
+    "compiles", "whole-plan XLA compilations (jit first-run + precompile)")
+SCAN_PASSES = METRICS.counter(
+    "scan_passes", "streamed morsel loops over a big table")
+MORSELS = METRICS.counter(
+    "morsels", "morsels executed across all streamed queries")
+BYTES_UPLOADED = METRICS.counter(
+    "bytes_uploaded", "host->device bytes staged for streamed morsels")
+HOST_FALLBACKS = METRICS.counter(
+    "host_fallbacks", "plan nodes served by the host oracle backend")
+PREFETCH_ERRORS = METRICS.counter(
+    "prefetch_errors", "staging-thread failures (morsel restaged sync)")
+STREAM_RESTARTS = METRICS.counter(
+    "stream_restarts", "throughput stream attempts beyond the first")
+REPLAY_MISMATCHES = METRICS.counter(
+    "replay_mismatches", "compiled schedules invalidated by capacity drift")

@@ -1,0 +1,128 @@
+"""Typed per-query execution stats.
+
+``Session.last_exec_stats`` used to be an untyped dict assembled in two
+divergent code paths (the in-core executor path and the streaming morsel
+path), and every PR grew new ad-hoc keys. ``ExecStats`` is the one typed
+shape both paths construct; the session installs it through a single
+method (``Session._finish_exec_stats``), keeping a dict view
+(``to_dict``) for every existing consumer — bench/power JSON, tests, and
+report summaries read the same keys as before.
+
+Field groups:
+- execution mode + device timing (every backend path);
+- compile-segmentation counters (multi-unit plans);
+- streaming/morsel counters (out-of-core queries);
+- failure observability: host-fallback reasons and ALL prefetch errors
+  (the old path kept only the first staging-thread failure).
+Unknown executor-surfaced keys ride ``extra`` verbatim so a new stat in
+the device layer never silently vanishes from reports.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+#: executor last_stats keys with first-class fields (everything else
+#: passes through ``extra``)
+_EXECUTOR_FIELDS = ("mode", "device_ms", "precompile_s", "nojit_reason",
+                    "transient", "spec_mismatch", "segments", "segments_run",
+                    "seg_device_ms")
+
+
+@dataclass
+class ExecStats:
+    """One query execution's observability record."""
+    # -- mode + device timing ------------------------------------------------
+    mode: str = ""           # record|compile+run|compiled|eager|adopted|
+    #                          streaming (the session's out-of-core path)
+    device_ms: Optional[float] = None
+    precompile_s: Optional[float] = None
+    nojit_reason: Optional[str] = None
+    transient: Optional[str] = None
+    spec_mismatch: Optional[str] = None
+    # -- compile segmentation ------------------------------------------------
+    segments: Optional[int] = None
+    segments_run: Optional[int] = None
+    seg_device_ms: Optional[float] = None
+    # -- streaming -----------------------------------------------------------
+    jobs: Optional[int] = None
+    morsels: Optional[int] = None
+    morsel_rows: Optional[int] = None
+    re_records: Optional[int] = None
+    shared_scan: Optional[bool] = None
+    scan_passes: Optional[int] = None
+    tables_streamed: Optional[int] = None
+    branches_served: Optional[int] = None
+    fused_groups: Optional[int] = None
+    bytes_uploaded: Optional[int] = None
+    morsels_per_table: Optional[dict] = None
+    narrow_lanes: Optional[bool] = None
+    lane_spec: Optional[dict] = None
+    # -- failure observability -----------------------------------------------
+    fallback_reasons: list = field(default_factory=list)
+    #: EVERY staging-thread failure of the run ("Type: message"), not just
+    #: the first — repeated prefetch degradation is a pattern, not an event
+    prefetch_error_details: list = field(default_factory=list)
+    #: forward-compat passthrough for executor keys without a field
+    extra: dict = field(default_factory=dict)
+
+    # -- constructors (the ONE place each path builds stats) -----------------
+    @classmethod
+    def from_executor(cls, last_stats: dict,
+                      fallbacks: Optional[list] = None) -> "ExecStats":
+        """Typed view of ``JaxExecutor.last_stats`` (in-core path)."""
+        known = {k: last_stats[k] for k in _EXECUTOR_FIELDS
+                 if k in last_stats}
+        extra = {k: v for k, v in last_stats.items()
+                 if k not in _EXECUTOR_FIELDS}
+        return cls(fallback_reasons=list(fallbacks or ()),
+                   extra=extra, **known)
+
+    @classmethod
+    def streaming(cls, *, jobs: int, morsels: int, morsel_rows: int,
+                  re_records: int, shared_scan: bool, scan_passes: int,
+                  tables_streamed: int, branches_served: int,
+                  fused_groups: int, bytes_uploaded: int,
+                  morsels_per_table: dict, narrow_lanes: bool,
+                  lane_spec: dict,
+                  prefetch_error_details: Optional[list] = None,
+                  fallbacks: Optional[list] = None) -> "ExecStats":
+        """Typed record of one out-of-core (morsel-streamed) execution."""
+        return cls(mode="streaming", jobs=jobs, morsels=morsels,
+                   morsel_rows=morsel_rows, re_records=re_records,
+                   shared_scan=shared_scan, scan_passes=scan_passes,
+                   tables_streamed=tables_streamed,
+                   branches_served=branches_served,
+                   fused_groups=fused_groups, bytes_uploaded=bytes_uploaded,
+                   morsels_per_table=dict(morsels_per_table),
+                   narrow_lanes=narrow_lanes, lane_spec=dict(lane_spec),
+                   prefetch_error_details=list(prefetch_error_details or ()),
+                   fallback_reasons=list(fallbacks or ()))
+
+    # -- views ---------------------------------------------------------------
+    def to_dict(self) -> dict:
+        """Backward-compatible dict view: exactly the keys the untyped
+        ``last_exec_stats`` carried (None fields dropped, legacy
+        ``prefetch_errors``/``prefetch_error`` aliases preserved)."""
+        out: dict = {}
+        if self.mode:
+            out["mode"] = self.mode
+        for k in ("device_ms", "precompile_s", "nojit_reason", "transient",
+                  "spec_mismatch", "segments", "segments_run",
+                  "seg_device_ms", "jobs", "morsels", "morsel_rows",
+                  "re_records", "shared_scan", "scan_passes",
+                  "tables_streamed", "branches_served", "fused_groups",
+                  "bytes_uploaded", "morsels_per_table", "narrow_lanes",
+                  "lane_spec"):
+            v = getattr(self, k)
+            if v is not None:
+                out[k] = v
+        out.update(self.extra)
+        if self.fallback_reasons:
+            out["fallback_reasons"] = list(self.fallback_reasons)
+        if self.prefetch_error_details:
+            out["prefetch_errors"] = len(self.prefetch_error_details)
+            out["prefetch_error"] = self.prefetch_error_details[0]
+            out["prefetch_error_details"] = list(self.prefetch_error_details)
+        return out

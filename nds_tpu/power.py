@@ -133,7 +133,9 @@ def run_one_query(session: Session, sql: str, query_name: str,
     statements = [s for s in sql.split(";") if s.strip()]
     result = None
     for stmt in statements:
-        result = session.sql(stmt, backend=backend)
+        # the query name labels spans and per-program device-time
+        # attribution (obs.device_time): "query9/root" etc.
+        result = session.sql(stmt, backend=backend, label=query_name)
     if output_prefix and result is not None:
         import pyarrow.parquet as pq
         from .engine.arrow_bridge import to_arrow
@@ -167,7 +169,8 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
                      late_mat: bool | None = None,
                      shared_scan: bool | None = None,
                      narrow_lanes: bool | None = None,
-                     verify_plans: str | None = None
+                     verify_plans: str | None = None,
+                     trace: str | None = None
                      ) -> list[tuple[str, int, int, int]]:
     """Run every query in the stream; returns (name, start_ms, end_ms, ms).
 
@@ -202,11 +205,18 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
     restores the wide int64 morsel upload layout bit-identically.
     verify_plans: static plan-IR verification mode (off|final|per-pass,
     engine/verify.py) — None takes EngineConfig.verify_plans.
+    trace: enable the obs span tracer for the whole stream and write a
+    Chrome trace-event file (Perfetto) to this path at the end — the
+    engine-internal complement of --profile_folder's jax traces.
     """
     from .check import check_json_summary_folder, check_query_subset_exists
     from .config import maybe_enable_compile_cache
+    from .obs.metrics import METRICS, QUERY_FAILURES
+    from .obs.trace import TRACER
 
     maybe_enable_compile_cache()
+    if trace:
+        TRACER.configure(enabled=True)
     check_json_summary_folder(json_summary_folder)
     config = EngineConfig.from_property_file(property_file)
     from .config import apply_decimal
@@ -325,6 +335,7 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
                     except Exception:
                         break  # the timed run reports the failure
             q_start = int(time.time() * 1000)
+            metrics_before = METRICS.snapshot()
             if profile_folder:
                 import jax
                 os.makedirs(profile_folder, exist_ok=True)
@@ -342,9 +353,15 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
                 fallback_queries[name] = list(session.last_fallbacks)
             if session.last_exec_stats:
                 report.record_exec_stats(session.last_exec_stats)
+            # per-query engine-counter delta: the uniform metrics block in
+            # every JSON summary (queries_run, cache hits, retries, faults,
+            # bytes uploaded... — obs.metrics glossary)
+            report.record_metrics(METRICS.delta(metrics_before))
             elapsed = report.summary["queryTimes"][-1]
             rows.append((name, q_start, q_start + elapsed, elapsed))
             status = report.finalize_status()
+            if status == "Failed":
+                QUERY_FAILURES.inc()
             print(f"{name}: {status} in {elapsed} ms", flush=True)
             if json_summary_folder:
                 report.write_summary(
@@ -363,6 +380,9 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
     finally:
         for s in armed:
             FAULTS.disarm(s)
+        if trace:
+            TRACER.write_chrome_trace(trace)
+            print(f"trace: {trace} (open in ui.perfetto.dev)", flush=True)
     if strict and fallback_queries:
         raise RuntimeError(
             "device fallbacks in strict mode: " + "; ".join(
@@ -474,6 +494,11 @@ def main(argv: list[str] | None = None) -> int:
                         "+ bit-packed validity) for A/B runs — morsels "
                         "then ride the wide int64 layout, bit-identical "
                         "results; property: nds.tpu.narrow_lanes")
+    p.add_argument("--trace", default=None, metavar="PATH",
+                   help="enable engine span tracing for the whole stream "
+                        "and write a Chrome trace-event file here (opens "
+                        "in ui.perfetto.dev); per-query engine metrics "
+                        "land in the JSON summaries either way")
     a = p.parse_args(argv)
     sub = a.sub_queries.split(",") if a.sub_queries else None
     inject = a.fault_inject.split(",") if a.fault_inject else None
@@ -488,7 +513,8 @@ def main(argv: list[str] | None = None) -> int:
                      late_mat=False if a.no_late_mat else None,
                      shared_scan=False if a.no_shared_scan else None,
                      narrow_lanes=False if a.no_narrow_lanes else None,
-                     verify_plans=a.verify_plans)
+                     verify_plans=a.verify_plans,
+                     trace=a.trace)
     return 0
 
 

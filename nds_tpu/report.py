@@ -20,7 +20,13 @@ from dataclasses import asdict, is_dataclass
 from typing import Any, Callable
 
 
-REDACT_MARKERS = ("TOKEN", "SECRET", "PASSWORD")
+#: summary-layout version: bump when keys change shape so downstream
+#: tooling can compare BENCH_r*.json / power summaries across rounds.
+#: v2: adds schemaVersion itself, env.host capture, metrics, spans.
+SCHEMA_VERSION = 2
+
+REDACT_MARKERS = ("TOKEN", "SECRET", "PASSWORD", "PASSWD", "CREDENTIAL",
+                  "APIKEY", "API_KEY", "AUTH")
 
 
 def _redacted_env() -> dict[str, str]:
@@ -29,6 +35,34 @@ def _redacted_env() -> dict[str, str]:
         if any(m in k.upper() for m in REDACT_MARKERS):
             v = "*********(redacted)"
         out[k] = v
+    return out
+
+
+def _host_capture() -> dict:
+    """Redacted host/runtime capture: enough to explain a cross-round
+    performance delta (CPU/arch/python/jax/backend) without leaking the
+    host identity — the hostname rides only as a short hash so runs from
+    the same machine are groupable but the name never lands in artifacts.
+    """
+    import hashlib
+    import platform
+    import socket
+
+    out: dict = {
+        "host_id": hashlib.sha1(
+            socket.gethostname().encode()).hexdigest()[:10],
+        "platform": platform.platform(),
+        "machine": platform.machine(),
+        "python": platform.python_version(),
+        "cpu_count": os.cpu_count(),
+    }
+    try:        # report.py is imported by jax-less tools (datagen)
+        import jax
+        out["jax"] = jax.__version__
+        out["jax_backend"] = jax.default_backend()
+        out["device_count"] = jax.device_count()
+    except Exception:
+        pass
     return out
 
 
@@ -42,8 +76,10 @@ class BenchReport:
         elif isinstance(engine_config, dict):
             cfg = {k: str(v) for k, v in engine_config.items()}
         self.summary = {
+            "schemaVersion": SCHEMA_VERSION,
             "env": {
                 "envVars": _redacted_env(),
+                "host": _host_capture(),
                 "engineConf": cfg,
                 "appName": app_name,
             },
@@ -91,6 +127,8 @@ class BenchReport:
                 self.record_task_failure(
                     f"attempt {len(attempt_trail)} failed "
                     f"({type(e).__name__}); retrying")
+                from .obs.metrics import RETRIES
+                RETRIES.inc()
                 time.sleep(retry.backoff(len(attempt_trail)))
         elapsed = int((time.perf_counter() - start) * 1000)
         if status == "Completed" and self.summary["taskFailures"]:
@@ -112,6 +150,12 @@ class BenchReport:
         reference nds_power.py:254): execution mode (record / compile+run /
         compiled / eager) and device milliseconds."""
         self.summary.setdefault("execStats", []).append(stats)
+
+    def record_metrics(self, delta: dict) -> None:
+        """Engine-metrics delta (obs.metrics.METRICS.delta over this unit
+        of work): the uniform counters block every runner's JSON carries."""
+        if delta:
+            self.summary["metrics"] = delta
 
     def finalize_status(self) -> str:
         """Re-derive the last status after post-run failure recording (task

@@ -96,6 +96,7 @@ class RetryPolicy:
         """Run ``fn`` under this policy; re-raises the last error when
         attempts are exhausted or the error classifies fatal. ``on_attempt``
         (attempt#, exception|None) observes every try."""
+        from .obs.metrics import RETRIES
         for attempt in range(1, self.max_attempts + 1):
             try:
                 out = fn(*args, **kwargs)
@@ -108,6 +109,7 @@ class RetryPolicy:
                 if attempt >= self.max_attempts or \
                         self.classify(e) == "fatal":
                     raise
+                RETRIES.inc()
                 sleep(self.backoff(attempt))
 
 
@@ -340,6 +342,9 @@ class FaultRegistry:
                     continue
                 s.fired += 1
                 triggered.append(s)
+        if triggered:
+            from .obs.metrics import FAULT_FIRINGS
+            FAULT_FIRINGS.inc(len(triggered))
         for s in triggered:         # act outside the lock (sleeps)
             where = f"{point} ({detail})" if detail else point
             if s.action == "delay":

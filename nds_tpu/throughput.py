@@ -132,6 +132,9 @@ def supervise_processes(jobs: list[tuple[int, list[str]]],
     def _spawn(sid: int) -> None:
         st = statuses[sid]
         st.attempts += 1
+        if st.attempts > 1:
+            from .obs.metrics import STREAM_RESTARTS
+            STREAM_RESTARTS.inc()
         FAULTS.fire("stream.spawn", str(sid))
         live[sid] = (spawn(cmds[sid]), clock())
         st.status = "Running"
@@ -195,6 +198,9 @@ def _supervised_thread_stream(sid: int, run, max_attempts: int,
     st = StreamStatus(sid)
     while st.attempts < max_attempts:
         st.attempts += 1
+        if st.attempts > 1:
+            from .obs.metrics import STREAM_RESTARTS
+            STREAM_RESTARTS.inc()
         try:
             FAULTS.fire("stream.spawn", str(sid))
             if stream_timeout:

@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""Summarize NDS-TPU observability artifacts on the terminal.
+
+Accepts any of the formats the obs layer emits and prints the aggregate
+view a Perfetto session would start from:
+
+- Chrome trace-event JSON (``bench.py --trace`` / ``power --trace``):
+  per-span-name rollup (count / total / mean / max ms) plus the slowest
+  individual spans with their attributes;
+- JSONL event logs (one event per line, same rollup);
+- bench JSON lines (the ``bench.py`` stdout object): the per-program
+  device-time table, per-query attribution fractions, and the engine
+  metrics snapshot.
+
+Usage:  python scripts/trace_report.py ARTIFACT [--top N]
+
+Pure stdlib; safe to point at artifacts from any round (schema_version
+tolerant — unknown keys are ignored).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load_events(path: str) -> list[dict] | None:
+    """Trace events from a Chrome trace file or JSONL log; None when the
+    file is some other JSON artifact (e.g. a bench summary)."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        events = []
+        for line in text.splitlines():
+            line = line.strip()
+            if line:
+                try:
+                    events.append(json.loads(line))
+                except json.JSONDecodeError as e:
+                    raise ValueError(
+                        f"{path}: neither JSON nor JSONL "
+                        f"({e})") from None
+        return events
+    if isinstance(doc, dict) and "traceEvents" in doc:
+        return doc["traceEvents"]
+    if isinstance(doc, list):
+        return doc
+    return None
+
+
+def rollup(events: list[dict]) -> list[dict]:
+    """Per-span-name aggregate over complete (ph == "X") events."""
+    agg: dict[str, dict] = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        row = agg.setdefault(e["name"], {"name": e["name"], "count": 0,
+                                         "total_ms": 0.0, "max_ms": 0.0})
+        ms = e.get("dur", 0) / 1000.0
+        row["count"] += 1
+        row["total_ms"] += ms
+        row["max_ms"] = max(row["max_ms"], ms)
+    out = sorted(agg.values(), key=lambda r: r["total_ms"], reverse=True)
+    for r in out:
+        r["mean_ms"] = r["total_ms"] / r["count"] if r["count"] else 0.0
+    return out
+
+
+def print_rollup(rows: list[dict]) -> None:
+    head = (f"{'span':<24} {'count':>7} {'total_ms':>11} {'mean_ms':>9} "
+            f"{'max_ms':>9}")
+    print(head)
+    print("-" * len(head))
+    for r in rows:
+        print(f"{r['name'][:24]:<24} {r['count']:>7} {r['total_ms']:>11.1f} "
+              f"{r['mean_ms']:>9.2f} {r['max_ms']:>9.1f}")
+
+
+def print_slowest(events: list[dict], top: int) -> None:
+    spans = sorted((e for e in events if e.get("ph") == "X"),
+                   key=lambda e: e.get("dur", 0), reverse=True)[:top]
+    print(f"\nslowest {len(spans)} spans:")
+    for e in spans:
+        args = e.get("args", {})
+        label = args.get("label") or args.get("table") or ""
+        detail = f" [{label}]" if label else ""
+        print(f"  {e.get('dur', 0) / 1000.0:>9.1f} ms  "
+              f"{e['name']}{detail}  {args}")
+
+
+def print_bench(doc: dict, top: int) -> None:
+    print(f"bench: {doc.get('metric')} = {doc.get('value')} "
+          f"{doc.get('unit', '')} (vs_baseline {doc.get('vs_baseline')})")
+    programs = doc.get("device_time_programs") or []
+    if programs:
+        print("\ntop programs by device time:")
+        head = (f"{'program':<40} {'runs':>5} {'total_ms':>10} "
+                f"{'mean_ms':>9} {'roofline':>9}")
+        print(head)
+        print("-" * len(head))
+        for r in programs[:top]:
+            rf = r.get("roofline_frac")
+            print(f"{r['program'][:40]:<40} {r['runs']:>5} "
+                  f"{r['device_ms']:>10.1f} {r['mean_ms']:>9.2f} "
+                  f"{(f'{rf:.4f}' if rf is not None else '-'):>9}")
+    attribution = doc.get("attribution_frac") or {}
+    if attribution:
+        print("\ndevice-time attribution (fraction of timed wall):")
+        for q, frac in attribution.items():
+            print(f"  {q:<12} {frac:.1%}")
+    metrics = doc.get("metrics") or {}
+    if metrics:
+        print("\nengine metrics:")
+        for name, v in metrics.items():
+            if v:
+                print(f"  {name:<24} {v}")
+    spans = doc.get("spans") or {}
+    if spans:
+        rows = [{"name": n, **r,
+                 "mean_ms": r["total_ms"] / r["count"] if r["count"] else 0.0}
+                for n, r in spans.items()]
+        rows.sort(key=lambda r: r["total_ms"], reverse=True)
+        print()
+        print_rollup(rows)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="trace_report.py")
+    p.add_argument("artifact", help="Chrome trace / JSONL event log / "
+                                    "bench JSON")
+    p.add_argument("--top", type=int, default=10,
+                   help="rows in the slowest-spans / top-programs tables")
+    a = p.parse_args(argv)
+    try:
+        events = load_events(a.artifact)
+        if events is not None and events and \
+                all(isinstance(e, dict) and "ph" in e for e in events):
+            print_rollup(rollup(events))
+            print_slowest(events, a.top)
+            return 0
+        with open(a.artifact) as f:
+            doc = json.load(f)
+    except (ValueError, OSError) as e:
+        print(f"trace_report: {e}", file=sys.stderr)
+        return 2
+    if isinstance(doc, dict):
+        print_bench(doc, a.top)
+        return 0
+    print(f"unrecognized artifact format: {a.artifact}", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

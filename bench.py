@@ -159,6 +159,12 @@ def main(argv=None) -> None:
     ooc_min = os.environ.get("NDS_TPU_BENCH_OOC_MIN_ROWS")
     if ooc_min:
         config.out_of_core_min_rows = int(ooc_min)
+    # A/B knob for the Pallas kernel swap (ISSUE 7): comma subset of
+    # sort,groupby,gather — bit-identical results, per-op kernel choice
+    pallas_env = os.environ.get("NDS_TPU_BENCH_PALLAS", "")
+    if pallas_env:
+        config.pallas_ops = tuple(
+            x.strip() for x in pallas_env.split(",") if x.strip())
     session = Session(config)
     setup_tables(session, wh_dir, "parquet")
     with open(stream_path) as f:
@@ -259,6 +265,10 @@ def main(argv=None) -> None:
         # the host — the per-run enumeration of non-device work
         "exec_modes": exec_modes,
         "fallback_reasons": fallback_reasons,
+        # the Pallas kernel configuration this run measured (ops enabled,
+        # platform mode, and the degradation reason when the XLA lowering
+        # served despite the flag)
+        "pallas": _pallas_summary(config, session),
         # fraction of each query's timed wall the per-program device times
         # explain (acceptance: >= 0.9)
         "attribution_frac": attribution,
@@ -281,6 +291,20 @@ def main(argv=None) -> None:
         log.info("top programs by device time:\n%s",
                  format_table(device_time_programs))
     print(json.dumps(out))
+
+
+def _pallas_summary(config, session) -> dict:
+    """The run's kernel configuration for the bench JSON: which op
+    families rode Pallas, the platform mode (tpu/interpret/off), and the
+    recorded fallback reason if the XLA lowering served anyway."""
+    from nds_tpu.engine.jax_backend import pallas_kernels as pk
+    mode, reason = pk.probe()
+    out = {"ops": sorted(pk.parse_ops(config.pallas_ops)), "mode": mode}
+    fb = session.last_exec_stats.get("pallas_fallback_reason") or \
+        (reason if (config.pallas_ops and mode == "off") else None)
+    if fb:
+        out["fallback_reason"] = fb
+    return out
 
 
 def scan_volume(session, sqls: list[str]) -> tuple[int, int]:

@@ -20,6 +20,13 @@
 #                early and cheaply; the obs suite gates here because the
 #                tracer/metrics hooks thread through the same session/
 #                streaming paths
+#   kernels    - Pallas kernel suite in INTERPRET mode (JAX_PLATFORMS=cpu
+#                exercises the real kernel bodies of
+#                engine/jax_backend/pallas_kernels.py): kernel-vs-XLA
+#                bit-identity properties + session-level on/off/oracle
+#                differentials; the SF0.01 NDS-query sweeps carry the slow
+#                marker and run in the full `test` stage instead, keeping
+#                this stage inside the tier-1 time budget
 #   test       - full pytest suite on an 8-virtual-device CPU mesh
 #   bench      - quick bench slice (SF 0.01) to catch perf regressions early
 #   all        - every stage in order
@@ -71,6 +78,14 @@ stage_planner() {
         tests/test_obs.py -q)
 }
 
+stage_kernels() {
+    # Pallas interpret-mode suite: the real kernel code paths (tiled
+    # bitonic sort, fused group-by partials, VMEM-staged gather) proven
+    # bit-identical to the XLA lowering before anything measures them
+    (cd "$REPO" && python -m pytest tests/test_pallas_kernels.py \
+        -q -m 'not slow')
+}
+
 stage_test() {
     (cd "$REPO" && python -m pytest tests/ -q --durations=15)
 }
@@ -96,15 +111,15 @@ run_stage() {
 }
 
 case "${1:-all}" in
-    native|resilience|static|planner|test|bench)
+    native|resilience|static|planner|kernels|test|bench)
         run_stage "$1" ;;
     all)
         total0=$SECONDS
-        for s in native resilience static planner test bench; do
+        for s in native resilience static planner kernels test bench; do
             run_stage "$s"
         done
         echo "stage all: $((SECONDS - total0))s" ;;
-    --list)     echo "native resilience static planner test bench all" ;;
-    *) echo "usage: run_ci.sh [native|resilience|static|planner|test|bench|all|--list]" >&2
+    --list)     echo "native resilience static planner kernels test bench all" ;;
+    *) echo "usage: run_ci.sh [native|resilience|static|planner|kernels|test|bench|all|--list]" >&2
        exit 2 ;;
 esac

@@ -83,6 +83,21 @@ class EngineConfig:
     # this big (small plans gain nothing and pay an extra small join + merge
     # aggregate). 0 fires unconditionally.
     late_mat_min_rows: int = 1 << 20
+    # TPU Pallas kernels for the sort/group-by/gather hot loops
+    # (engine/jax_backend/pallas_kernels.py): a subset of
+    # {"sort", "groupby", "gather"} enables the hand-tiled kernel for that
+    # op family — (a) VMEM-blocked bitonic segmented sort behind
+    # dense_rank/compaction/build-side, (b) fused tile-masked group-by
+    # partial aggregation replacing the factorize->scatter-add pipeline,
+    # (c) VMEM-staged batched multi-column gather for join/late-mat row
+    # materialization. Results are BIT-IDENTICAL to the XLA lowering (the
+    # default, empty = all off); program caches key on the choice. On a
+    # CPU backend the kernels run in Pallas interpret mode (CI exercises
+    # the real kernel bodies); on backends without TPU Pallas the engine
+    # logs one warning, falls back to XLA, and records
+    # pallas_fallback_reason in last_exec_stats. Property:
+    # nds.tpu.pallas_ops=sort,groupby,gather; power --pallas_ops.
+    pallas_ops: tuple[str, ...] = ()
     # static plan-IR verification between planner rewrite passes
     # (engine/verify.py via planner.PassPipeline):
     #   "off"      — zero verification cost (bench/production default)

@@ -43,6 +43,7 @@ from ..plan import (
     iter_plan_nodes, parameterize_plan, replace_plan_nodes,
 )
 from . import jexprs, kernels
+from . import pallas_kernels as _pallas
 from .device import (DCol, DTable, PackedTable, bucket, free_dtable,
                      phys_dtype, rank_key, string_rank_lut, to_device,
                      to_host, unpack_table, widen_col)
@@ -137,13 +138,17 @@ class CompiledQuery:
 
     def __init__(self, plan, decisions: list, scan_keys: tuple,
                  mesh=None, param_dtypes: tuple = (),
-                 shard_min_rows: int = 1 << 18, label: str = ""):
+                 shard_min_rows: int = 1 << 18, label: str = "",
+                 pallas_ops: frozenset = frozenset()):
         self.plan = plan
         self.decisions = decisions
         self.scan_keys = scan_keys
         self.mesh = mesh
         self.param_dtypes = param_dtypes
         self.shard_min_rows = shard_min_rows
+        # the kernel choice is part of the program's identity: replay must
+        # trace the same pallas/XLA sides the recording executor took
+        self.pallas_ops = frozenset(pallas_ops)
         # device-time attribution key (obs.device_time): "<query>/<unit>";
         # every run's measured dispatch wall accumulates under it, and the
         # jax.profiler annotation carries it into hardware profiles
@@ -167,7 +172,8 @@ class CompiledQuery:
         # consume a differently-shaped schedule
         ex = JaxExecutor(_no_load, recorder=rec, scan_tables=scans,
                          mesh=self.mesh, params=params,
-                         shard_min_rows=self.shard_min_rows)
+                         shard_min_rows=self.shard_min_rows,
+                         pallas_ops=self.pallas_ops)
         if isinstance(self.plan, (list, tuple)):
             outs = []
             for p in self.plan:
@@ -430,8 +436,14 @@ class JaxExecutor:
                  segment_min_cte_nodes: int = 8,
                  segment_cache_entries: int = 16,
                  scan_budget_bytes: int = 10 << 30,
-                 params: Optional[tuple] = None):
+                 params: Optional[tuple] = None,
+                 pallas_ops=frozenset()):
         self._load_table = load_table
+        # per-op Pallas kernel activation (EngineConfig.pallas_ops): off
+        # under a mesh — pack probes and shard_map partitioning assume the
+        # generic lowering there, and the measured target is single-chip
+        self._pallas_ops = frozenset() if mesh is not None \
+            else _pallas.parse_ops(pallas_ops)
         # hoisted literal values for the in-flight execution: python scalars
         # under eager record, traced 0-d arrays under compiled replay
         self._params = params
@@ -755,7 +767,8 @@ class JaxExecutor:
                                    param_dtypes=ent.get("param_dtypes", ()),
                                    shard_min_rows=self._shard_min_rows,
                                    label=ent.get("label",
-                                                 self._unit_label(key)))
+                                                 self._unit_label(key)),
+                                   pallas_ops=self._pallas_ops)
                 try:
                     out = self._run_compiled(cq, ent, keep_device)
                     ent["cq"] = cq
@@ -820,9 +833,10 @@ class JaxExecutor:
         import hashlib
         x64 = jax.config.read("jax_enable_x64")
         body = _plan_fingerprint(pplan)
+        pk = ",".join(sorted(self._pallas_ops))
         return hashlib.sha1(
-            f"{body}|x64={x64}|smr={self._shard_min_rows}".encode()
-        ).hexdigest()
+            f"{body}|x64={x64}|smr={self._shard_min_rows}|pallas={pk}"
+            .encode()).hexdigest()
 
     def _adopt_shared(self, key, fp, pvalues: tuple, pdtypes: tuple) -> bool:
         """Install another stream's entry (schedule + program) for `key`."""
@@ -948,7 +962,8 @@ class JaxExecutor:
                                ent["scan_keys"], mesh=self._mesh,
                                param_dtypes=ent.get("param_dtypes", ()),
                                shard_min_rows=self._shard_min_rows,
-                               label=ent.get("label", self._unit_label(k)))
+                               label=ent.get("label", self._unit_label(k)),
+                               pallas_ops=self._pallas_ops)
             todo.append((k, ent, cq, specs))
         if not todo:
             return {}
@@ -1135,6 +1150,9 @@ class JaxExecutor:
         return out
 
     def execute(self, node: PlanNode) -> DTable:
+        # install this executor's kernel choice for every kernel dispatched
+        # below (thread-local: concurrent compile-pool traces don't race)
+        _pallas.set_active(self._pallas_ops)
         key = id(node)
         if key in self._memo:
             return self._memo[key]
@@ -1339,11 +1357,7 @@ class JaxExecutor:
             return t
         perm, _ = kernels.compaction_perm(t.alive)
         perm = perm[:cap]
-        cols = [DCol(c.dtype, c.data[perm], c.valid[perm], c.dictionary,
-                     None if c.parts is None else tuple(
-                         DCol(p.dtype, p.data[perm], p.valid[perm], p.dictionary)
-                         for p in c.parts))
-                for c in t.cols]
+        cols = _gather_cols(t.cols, perm)
         alive = jnp.arange(cap, dtype=_I32) < count_t
         return DTable(t.names, cols, alive)
 
@@ -1467,7 +1481,7 @@ class JaxExecutor:
         key_valid = [c.valid for c in key_cols]
         perm = kernels.sort_perm(key_data, key_valid,
                                  kernels.sort_specs(node.keys), child.alive)
-        cols = [_gather_col(c, perm) for c in child.cols]
+        cols = _gather_cols(child.cols, perm)
         return DTable(list(node.out_names), cols, child.alive[perm])
 
     def _distinct_alive(self, t: DTable, col_idx: list[int]) -> jax.Array:
@@ -1628,11 +1642,20 @@ class JaxExecutor:
                 key_ops.append(jnp.where(v & alive, d,
                                          jnp.zeros((), d.dtype)))
             nkey_ops = len(key_ops)
-        out = lax.sort(tuple(key_ops) + tuple(payloads) + (iota,),
-                       num_keys=nkey_ops, is_stable=True)
-        sorted_keys = out[:nkey_ops]
-        sorted_pays = out[nkey_ops:-1]
-        perm = out[-1]
+        if nkey_ops == 1 and _pallas.op_active("sort"):
+            # tiled segmented sort: the packed key rides the VMEM-blocked
+            # bitonic network with ONLY the row index as payload, and the
+            # agg payloads follow via one batched gather — instead of every
+            # payload riding every merge pass of the multi-operand lax.sort
+            skey, perm = kernels._sort1(key_ops[0], iota)
+            sorted_keys = (skey,)
+            sorted_pays = tuple(kernels.gather_many(list(payloads), perm))
+        else:
+            out = lax.sort(tuple(key_ops) + tuple(payloads) + (iota,),
+                           num_keys=nkey_ops, is_stable=True)
+            sorted_keys = out[:nkey_ops]
+            sorted_pays = out[nkey_ops:-1]
+            perm = out[-1]
         iota_s = iota
         alive_sorted = iota_s < jnp.sum(alive.astype(_I32))
 
@@ -2386,7 +2409,7 @@ class JaxExecutor:
             return self._maybe_compact(
                 DTable(list(node.out_names), left.cols, alive))
 
-        rcols = [_gather_col(c, safe_r) for c in right.cols]
+        rcols = _gather_cols(right.cols, safe_r)
         names = list(node.out_names) if len(node.out_names) == \
             len(left.cols) + len(rcols) else \
             [f"__c{i}" for i in range(len(left.cols) + len(rcols))]
@@ -2426,8 +2449,8 @@ class JaxExecutor:
         left_idx, build_pos, alive_out = kernels.expand_join(
             lo, cnt, left.alive, cap_out)
         right_rows = perm_r[jnp.clip(build_pos, 0, right.capacity - 1)]
-        cols = [_gather_col(c, left_idx) for c in left.cols] + \
-               [_gather_col(c, right_rows) for c in right.cols]
+        cols = _gather_cols(left.cols, left_idx) + \
+            _gather_cols(right.cols, right_rows)
         out = DTable(self._combined_names(node, len(cols)), cols, alive_out)
         out = self._apply_residual(residual, out)
         return out, left_idx, right_rows
@@ -2499,6 +2522,39 @@ def _gather_col(c: DCol, idx: jax.Array) -> DCol:
         parts = tuple(DCol(p.dtype, p.data[idx], p.valid[idx], p.dictionary)
                       for p in c.parts)
     return DCol(c.dtype, c.data[idx], c.valid[idx], c.dictionary, parts)
+
+
+def _gather_cols(cols: list, idx: jax.Array) -> list:
+    """Gather EVERY column of a table by one index vector — the join /
+    sort / late-materialization shape. With the "gather" pallas op active
+    the flattened (data, valid, parts...) arrays ride batched VMEM-staged
+    kernel passes (kernels.gather_many); otherwise per-column XLA gathers
+    exactly as before. Both sides are pure permutation reads."""
+    if not _pallas.op_active("gather"):
+        return [_gather_col(c, idx) for c in cols]
+    arrays: list = []
+    for c in cols:
+        arrays.append(c.data)
+        arrays.append(c.valid)
+        if c.parts is not None:
+            for p in c.parts:
+                arrays.append(p.data)
+                arrays.append(p.valid)
+    flat = kernels.gather_many(arrays, idx)
+    out: list = []
+    i = 0
+    for c in cols:
+        data, valid = flat[i], flat[i + 1]
+        i += 2
+        parts = None
+        if c.parts is not None:
+            ps = []
+            for p in c.parts:
+                ps.append(DCol(p.dtype, flat[i], flat[i + 1], p.dictionary))
+                i += 2
+            parts = tuple(ps)
+        out.append(DCol(c.dtype, data, valid, c.dictionary, parts))
+    return out
 
 
 def _joinable_pair(a: DCol, b: DCol) -> tuple[jax.Array, jax.Array]:

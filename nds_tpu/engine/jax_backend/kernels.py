@@ -21,12 +21,64 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..plan import AggSpec, SortKey, WindowFunc
+from . import pallas_kernels as _pk
 
 _I32 = jnp.int32
 
 
 def _iota(n: int) -> jax.Array:
     return jnp.arange(n, dtype=_I32)
+
+
+# ---------------------------------------------------------------------------
+# Pallas dispatch seams (ISSUE 7): each helper swaps in the hand-tiled
+# pallas_kernels implementation when its op flag is active for the in-flight
+# executor (EngineConfig.pallas_ops via pallas_kernels.set_active) and keeps
+# the existing XLA lowering — bit-identically — otherwise. No schedule
+# decision may depend on which side runs: both sides return identical bits.
+# ---------------------------------------------------------------------------
+
+def _sort1(key: jax.Array, idx: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Single-integer-key stable sort carrying an iota/permutation payload:
+    the (key, idx) comparator is a total order, so the tiled bitonic
+    network reproduces `lax.sort(..., is_stable=True)` exactly. Fact-scale
+    arrays only (SORT_MIN_ROWS): each pallas call SITE is one kernel
+    compile, and dimension-scale sorts never earn it back."""
+    if int(key.shape[0]) >= _pk.SORT_MIN_ROWS and _pk.op_active("sort"):
+        return _pk.sort_pairs(key, idx)
+    return lax.sort((key, idx), num_keys=1, is_stable=True)
+
+
+def gather_many(arrays: list, idx: jax.Array) -> list:
+    """Batched same-index gather (multi-column join/late-mat shape): one
+    VMEM-staged pallas pass over all stageable columns when "gather" is
+    active and the index vector is fact-scale, else the plain XLA gathers.
+    Pure permutation reads — always bit-identical."""
+    if int(idx.shape[0]) >= _pk.GATHER_MIN_ROWS and _pk.op_active("gather"):
+        return _pk.take_many(list(arrays), idx)
+    return [a[idx] for a in arrays]
+
+
+def _seg_multi(pairs: list, gid: jax.Array, num_segments: int) -> list:
+    """Several segment reductions over ONE gid vector. With "groupby"
+    active, every eligible operand rides one fused pallas pass (a single
+    per-tile membership mask serves them all); the rest — and the whole
+    list when inactive — keep the per-operand `_seg` path."""
+    out: list = [None] * len(pairs)
+    fused: list[int] = []
+    if int(gid.shape[0]) >= _pk.GROUPBY_MIN_ROWS and \
+            _pk.op_active("groupby"):
+        fused = [i for i, (d, op) in enumerate(pairs)
+                 if _pk.seg_supported(d, num_segments, op)]
+        if fused:
+            res = _pk.seg_reduce_multi([pairs[i] for i in fused], gid,
+                                       num_segments)
+            for i, r in zip(fused, res):
+                out[i] = r
+    for i, (d, op) in enumerate(pairs):
+        if out[i] is None:
+            out[i] = _seg(d, gid, num_segments, op)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -71,7 +123,16 @@ def unscatter(perm: jax.Array, values: tuple) -> tuple:
     permutation of 0..n-1, so sorting restores original row order) carrying
     `values` as payload operands. Measured on TPU: an n-sized scatter costs
     ~60x a 2-operand sort — .at[perm].set() is the single most expensive
-    way to invert a permutation on this hardware."""
+    way to invert a permutation on this hardware.
+
+    Pallas tier: sort only (perm, iota) — yielding argsort(perm), i.e. the
+    inverse permutation — then gather the payloads through it in one
+    batched pass instead of carrying every payload through the merge
+    network. perm's values are distinct, so both forms are bit-identical.
+    """
+    if int(perm.shape[0]) >= _pk.SORT_MIN_ROWS and _pk.op_active("sort"):
+        _, inv = _pk.sort_pairs(perm, _iota(perm.shape[0]))
+        return tuple(gather_many(list(values), inv))
     out = lax.sort((perm,) + tuple(values), num_keys=1, is_stable=True)
     return out[1:]
 
@@ -180,7 +241,7 @@ def dense_rank_packsort(key_data: list[jax.Array], key_valid: list[jax.Array],
     n = alive.shape[0]
     c = _pack_keys(key_data, key_valid, alive)
     key = jnp.where(alive, c, jnp.iinfo(c.dtype).max)
-    skey, perm = lax.sort((key, _iota(n)), num_keys=1, is_stable=True)
+    skey, perm = _sort1(key, _iota(n))
     alive_s = alive[perm]
     new_group = alive_s & jnp.concatenate(
         [jnp.ones(1, bool), skey[1:] != skey[:-1]])
@@ -202,8 +263,7 @@ def compaction_perm(alive: jax.Array) -> tuple[jax.Array, jax.Array]:
     than the n-sized scatter this used to do (TPU scatters serialize).
     Entries past `count` are dead-row indices (callers mask by count)."""
     n = alive.shape[0]
-    _, perm = lax.sort(((~alive).astype(_I32), _iota(n)), num_keys=1,
-                       is_stable=True)
+    _, perm = _sort1((~alive).astype(_I32), _iota(n))
     return perm, jnp.sum(alive.astype(_I32))
 
 
@@ -264,6 +324,13 @@ _MASKED_SEG_MAX = 64
 
 
 def _seg(data: jax.Array, gid: jax.Array, num_segments: int, op: str) -> jax.Array:
+    # pallas tier first: the fused tile-masked partial-agg kernel replaces
+    # the serialized scatter-add for bounded segment counts; eligibility is
+    # static (dtype/op/cap/rows), so one compiled program is consistent
+    if int(gid.shape[0]) >= _pk.GROUPBY_MIN_ROWS \
+            and _pk.op_active("groupby") \
+            and _pk.seg_supported(data, num_segments, op):
+        return _pk.seg_reduce(data, gid, num_segments, op)
     if (num_segments <= _MASKED_SEG_MAX and isinstance(data, jax.core.Tracer)
             and jnp.issubdtype(data.dtype, jnp.integer)):
         seg_ids = jnp.arange(num_segments, dtype=gid.dtype)
@@ -296,16 +363,21 @@ def agg_apply(gid: jax.Array, alive: jax.Array, func: str, arg,
         return vals.astype(int_out), jnp.ones(cap_out, bool)
     data, valid = arg
     contrib = alive & valid
-    cnt = _seg(contrib.astype(int_out), gid, cap_out, "sum")
+    # every aggregate needs the per-group contribution count alongside its
+    # value reduction: batching both through _seg_multi lets the pallas
+    # groupby tier compute them in ONE fused tile pass (one membership
+    # mask, several operands) instead of one scatter pipeline each
+    cnt_op = contrib.astype(int_out)
     if func == "count":
-        return cnt, jnp.ones(cap_out, bool)
+        return _seg(cnt_op, gid, cap_out, "sum"), jnp.ones(cap_out, bool)
     if func == "sum":
         z = jnp.where(contrib, data, jnp.zeros((), data.dtype))
-        return _seg(z, gid, cap_out, "sum"), cnt > 0
+        cnt, s = _seg_multi([(cnt_op, "sum"), (z, "sum")], gid, cap_out)
+        return s, cnt > 0
     if func in ("min", "max"):
         big = _extreme(data.dtype, func)
         z = jnp.where(contrib, data, big)
-        vals = _seg(z, gid, cap_out, func)
+        cnt, vals = _seg_multi([(cnt_op, "sum"), (z, func)], gid, cap_out)
         vals = jnp.where(cnt > 0, vals, jnp.zeros((), data.dtype))
         return vals, cnt > 0
     if func == "avg":
@@ -317,11 +389,10 @@ def agg_apply(gid: jax.Array, alive: jax.Array, func: str, arg,
         if jnp.issubdtype(data.dtype, jnp.integer) and \
                 jax.config.read("jax_enable_x64"):
             z = jnp.where(contrib, data, jnp.zeros((), data.dtype))
-            s = _seg(z, gid, cap_out, "sum")
         else:
             z = jnp.where(contrib, data, jnp.zeros((), data.dtype)).astype(
                 _float_dtype())
-            s = _seg(z, gid, cap_out, "sum")
+        cnt, s = _seg_multi([(cnt_op, "sum"), (z, "sum")], gid, cap_out)
         return (s.astype(_float_dtype()) /
                 jnp.maximum(cnt, 1).astype(_float_dtype())), cnt > 0
     if func == "stddev_samp":
@@ -331,11 +402,12 @@ def agg_apply(gid: jax.Array, alive: jax.Array, func: str, arg,
         zf = jnp.where(contrib, data, 0).astype(_float_dtype())
         if jnp.issubdtype(data.dtype, jnp.integer) and \
                 jax.config.read("jax_enable_x64"):
-            s = _seg(jnp.where(contrib, data, jnp.zeros((), data.dtype)),
-                     gid, cap_out, "sum").astype(_float_dtype())
+            s_op = jnp.where(contrib, data, jnp.zeros((), data.dtype))
         else:
-            s = _seg(zf, gid, cap_out, "sum")
-        s2 = _seg(zf * zf, gid, cap_out, "sum")
+            s_op = zf
+        cnt, s, s2 = _seg_multi([(cnt_op, "sum"), (s_op, "sum"),
+                                 (zf * zf, "sum")], gid, cap_out)
+        s = s.astype(_float_dtype())
         nf = cnt.astype(_float_dtype())
         var = (s2 - s * s / jnp.maximum(nf, 1.0)) / jnp.maximum(nf - 1.0, 1.0)
         return jnp.sqrt(jnp.maximum(var, 0.0)), cnt > 1
@@ -511,10 +583,8 @@ def window_ordered_core(sgid: jax.Array, tie_data: list[jax.Array],
 def build_side(gid_right: jax.Array, alive_right: jax.Array
                ) -> tuple[jax.Array, jax.Array]:
     """Sort right-side gids (dead rows pushed to +inf); returns (sorted_gid, perm)."""
-    n = alive_right.shape[0]
     key = jnp.where(alive_right, gid_right, jnp.iinfo(_I32).max)
-    sorted_gid, perm = lax.sort((key, _iota(n)), num_keys=1, is_stable=True)
-    return sorted_gid, perm
+    return _sort1(key, _iota(alive_right.shape[0]))
 
 
 def probe_counts_by_gid(build_gid: jax.Array, build_alive: jax.Array,

@@ -124,9 +124,13 @@ class Session:
         """The session-held device executor: device-resident scan cache and
         compiled plans persist across the whole query stream (the reference
         keeps tables hot on the executors across the 103-query power run)."""
-        if self._jax_exec is None or self._jax_exec_gen != self._generation:
+        # invalidation key includes the kernel choice: toggling pallas_ops
+        # on a live session (A/B runs) must rebuild the executor — its
+        # cached programs/schedules embed which kernels they traced
+        cfg = self.config
+        exec_key = (self._generation, tuple(sorted(cfg.pallas_ops)))
+        if self._jax_exec is None or self._jax_exec_gen != exec_key:
             from .jax_backend import JaxExecutor
-            cfg = self.config
             self._jax_exec = JaxExecutor(
                 self.load_table, jit_plans=cfg.jit_plans,
                 mesh=self._device_mesh(),
@@ -134,8 +138,9 @@ class Session:
                 segment_plan_nodes=cfg.segment_plan_nodes,
                 segment_min_cte_nodes=cfg.segment_min_cte_nodes,
                 segment_cache_entries=cfg.segment_cache_entries,
-                scan_budget_bytes=int(cfg.scan_budget_gb * (1 << 30)))
-            self._jax_exec_gen = self._generation
+                scan_budget_bytes=int(cfg.scan_budget_gb * (1 << 30)),
+                pallas_ops=cfg.pallas_ops)
+            self._jax_exec_gen = exec_key
         return self._jax_exec
 
     def _dec_as_int(self) -> bool:
@@ -438,6 +443,19 @@ class Session:
         come through here): installs the typed record, its backward-
         compatible dict view, and rolls the run into the process-wide
         metrics registry."""
+        if self.config.pallas_ops:
+            from .jax_backend import pallas_kernels as _pk
+            ops = sorted(_pk.parse_ops(self.config.pallas_ops))
+            if self._device_mesh() is not None:
+                stats.pallas_fallback_reason = \
+                    "pallas_ops disabled under a device mesh"
+            else:
+                stats.pallas_ops = ops
+                reason = _pk.fallback_reason()
+                if reason:
+                    # graceful degradation (one warning already logged by
+                    # pallas_kernels): record WHY the XLA lowering served
+                    stats.pallas_fallback_reason = reason
         self.last_exec_stats_typed = stats
         self.last_exec_stats = stats.to_dict()
         if stats.fallback_reasons:
@@ -462,7 +480,8 @@ class Session:
                 cfg.stream_compact_rows, cfg.shared_scan,
                 cfg.stream_fusion_max_branches, cfg.late_materialization,
                 cfg.late_mat_min_rows, cfg.decimal_physical, cfg.use_jax,
-                cfg.narrow_lanes, tuple(cfg.mesh_shape))
+                cfg.narrow_lanes, tuple(cfg.mesh_shape),
+                tuple(sorted(cfg.pallas_ops)))
 
     def _sql_streaming(self, query: str):
         """Out-of-core execution (generalized round 5, shared-scan round 7):
@@ -637,7 +656,8 @@ class Session:
             segment_plan_nodes=cfg.segment_plan_nodes,
             segment_min_cte_nodes=cfg.segment_min_cte_nodes,
             segment_cache_entries=cfg.segment_cache_entries,
-            scan_budget_bytes=int(cfg.scan_budget_gb * (1 << 30)))
+            scan_budget_bytes=int(cfg.scan_budget_gb * (1 << 30)),
+            pallas_ops=cfg.pallas_ops)
         return {"jexec": jexec, "current": current}
 
     def _incore_partial(self, shared: dict, branch):
@@ -717,7 +737,8 @@ class Session:
                     list(group.plans), decisions, scan_keys,
                     mesh=jexec._mesh,
                     shard_min_rows=jexec._shard_min_rows,
-                    label=f"{self._active_label}/morsel:{group.table}")]
+                    label=f"{self._active_label}/morsel:{group.table}",
+                    pallas_ops=jexec._pallas_ops)]
                 state["ents"] = [{"scan_keys": scan_keys}]
             else:
                 # fusion over budget (or single member): per-member
@@ -734,7 +755,8 @@ class Session:
                         p, decisions, scan_keys, mesh=jexec._mesh,
                         shard_min_rows=jexec._shard_min_rows,
                         label=f"{self._active_label}/morsel:"
-                              f"{group.table}#{bi}"))
+                              f"{group.table}#{bi}",
+                        pallas_ops=jexec._pallas_ops))
                     ents.append({"scan_keys": scan_keys})
                 state["cqs"], state["ents"] = cqs, ents
             state["fused"] = fuse

@@ -142,6 +142,62 @@ class ProgramRegistry:
 PROGRAMS = ProgramRegistry()
 
 
+# ---------------------------------------------------------------------------
+# fetch-based standalone timing (PERF.md measurement caveat, fixed at the
+# source): on this tunneled platform ``block_until_ready`` returns when the
+# dispatch is ACKNOWLEDGED, not when the result exists, so bare
+# block-until-ready timings of standalone kernels read ~0 ms. Timing around
+# a result FETCH (``jax.device_get``) closes the gap — the D2H round trip
+# is part of what a program costs the stream anyway (see module docstring).
+# On the host CPU backend arrays are already local and block_until_ready is
+# an honest completion barrier, so the platform check keeps the cheap path.
+# ---------------------------------------------------------------------------
+
+def fetch_timing_required() -> bool:
+    """True on accelerator/tunneled platforms where only a result fetch
+    proves the computation ran to completion."""
+    import jax
+    try:
+        return jax.devices()[0].platform != "cpu"
+    except Exception:          # pragma: no cover - no backend at all
+        return False
+
+
+def timed_call(fn, *args) -> tuple[float, object]:
+    """One measured call of ``fn(*args)``: returns (wall ms, host result).
+
+    The completion barrier is a ``device_get`` fetch when the platform
+    requires it, else ``block_until_ready`` (+ the same host conversion so
+    both paths return comparable objects)."""
+    import time as _time
+
+    import jax
+    t0 = _time.perf_counter()
+    out = fn(*args)
+    if fetch_timing_required():
+        host = jax.device_get(out)
+    else:
+        host = jax.device_get(jax.block_until_ready(out))
+    return (_time.perf_counter() - t0) * 1000.0, host
+
+
+def measure_ms(fn, *args, iters: int = 3, warmup: int = 1,
+               label: Optional[str] = None) -> float:
+    """Best-of-`iters` fetch-based wall ms of ``fn(*args)`` after `warmup`
+    untimed calls (compile excluded). With `label`, every timed run also
+    reports into ``PROGRAMS`` so kernel microbenches surface in the same
+    per-program attribution table as the engine's compiled queries."""
+    for _ in range(max(0, warmup)):
+        timed_call(fn, *args)
+    best = float("inf")
+    for _ in range(max(1, iters)):
+        ms, _ = timed_call(fn, *args)
+        best = min(best, ms)
+        if label is not None:
+            PROGRAMS.record_run(label, ms)
+    return best
+
+
 def coverage(table_rows: list[dict], measured_wall_ms: float) -> float:
     """Fraction of a measured wall-clock interval the per-program device
     times account for (the >=90% attribution acceptance check)."""

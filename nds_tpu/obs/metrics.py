@@ -168,3 +168,11 @@ STREAM_RESTARTS = METRICS.counter(
     "stream_restarts", "throughput stream attempts beyond the first")
 REPLAY_MISMATCHES = METRICS.counter(
     "replay_mismatches", "compiled schedules invalidated by capacity drift")
+# Pallas kernel dispatches (pallas_kernels): counted at build time — once
+# per kernel instantiation under a jit trace, once per call in eager record
+PALLAS_SORT_CALLS = METRICS.counter(
+    "pallas_sort_calls", "tiled bitonic sort_pairs dispatches (pallas)")
+PALLAS_GROUPBY_CALLS = METRICS.counter(
+    "pallas_groupby_calls", "fused seg_reduce partial-agg dispatches (pallas)")
+PALLAS_GATHER_CALLS = METRICS.counter(
+    "pallas_gather_calls", "VMEM-staged take_many dispatches (pallas)")

@@ -59,6 +59,11 @@ class ExecStats:
     morsels_per_table: Optional[dict] = None
     narrow_lanes: Optional[bool] = None
     lane_spec: Optional[dict] = None
+    # -- pallas kernels (EngineConfig.pallas_ops) ----------------------------
+    #: the validated op subset active for this execution (None = flag off)
+    pallas_ops: Optional[list] = None
+    #: why the XLA lowering served despite the flag (platform/import/mesh)
+    pallas_fallback_reason: Optional[str] = None
     # -- failure observability -----------------------------------------------
     fallback_reasons: list = field(default_factory=list)
     #: EVERY staging-thread failure of the run ("Type: message"), not just
@@ -114,7 +119,7 @@ class ExecStats:
                   "re_records", "shared_scan", "scan_passes",
                   "tables_streamed", "branches_served", "fused_groups",
                   "bytes_uploaded", "morsels_per_table", "narrow_lanes",
-                  "lane_spec"):
+                  "lane_spec", "pallas_ops", "pallas_fallback_reason"):
             v = getattr(self, k)
             if v is not None:
                 out[k] = v

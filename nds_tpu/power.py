@@ -170,6 +170,7 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
                      shared_scan: bool | None = None,
                      narrow_lanes: bool | None = None,
                      verify_plans: str | None = None,
+                     pallas_ops: str | None = None,
                      trace: str | None = None
                      ) -> list[tuple[str, int, int, int]]:
     """Run every query in the stream; returns (name, start_ms, end_ms, ms).
@@ -203,6 +204,9 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
     stopped, keeping the original Power Start Time.
     narrow_lanes: --no_narrow_lanes A/B override (None = config): False
     restores the wide int64 morsel upload layout bit-identically.
+    pallas_ops: comma list of {sort,groupby,gather} enabling the TPU
+    Pallas kernel for that op family (None = take EngineConfig.pallas_ops;
+    results are bit-identical to the XLA lowering either way).
     verify_plans: static plan-IR verification mode (off|final|per-pass,
     engine/verify.py) — None takes EngineConfig.verify_plans.
     trace: enable the obs span tracer for the whole stream and write a
@@ -229,6 +233,9 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
         config.narrow_lanes = narrow_lanes
     if verify_plans is not None:  # --verify_plans override
         config.verify_plans = verify_plans
+    if pallas_ops is not None:   # --pallas_ops A/B override
+        config.pallas_ops = tuple(
+            x.strip() for x in pallas_ops.split(",") if x.strip())
     session = Session(config)
     setup_tables(session, input_prefix, input_format)
 
@@ -494,6 +501,14 @@ def main(argv: list[str] | None = None) -> int:
                         "+ bit-packed validity) for A/B runs — morsels "
                         "then ride the wide int64 layout, bit-identical "
                         "results; property: nds.tpu.narrow_lanes")
+    p.add_argument("--pallas_ops", default=None, metavar="OPS",
+                   help="comma list of {sort,groupby,gather}: enable the "
+                        "hand-tiled TPU Pallas kernel for that op family "
+                        "(engine/jax_backend/pallas_kernels.py), bit-"
+                        "identical to the default XLA lowering; on non-TPU "
+                        "backends kernels run in interpret mode (cpu) or "
+                        "fall back with pallas_fallback_reason recorded; "
+                        "property: nds.tpu.pallas_ops")
     p.add_argument("--trace", default=None, metavar="PATH",
                    help="enable engine span tracing for the whole stream "
                         "and write a Chrome trace-event file here (opens "
@@ -514,6 +529,7 @@ def main(argv: list[str] | None = None) -> int:
                      shared_scan=False if a.no_shared_scan else None,
                      narrow_lanes=False if a.no_narrow_lanes else None,
                      verify_plans=a.verify_plans,
+                     pallas_ops=a.pallas_ops,
                      trace=a.trace)
     return 0
 

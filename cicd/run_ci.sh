@@ -27,6 +27,13 @@
 #                differentials; the SF0.01 NDS-query sweeps carry the slow
 #                marker and run in the full `test` stage instead, keeping
 #                this stage inside the tier-1 time budget
+#   mesh       - sharded morsel execution (EngineConfig.mesh_shards) on
+#                8 forced virtual CPU devices: sharded-vs-single-chip
+#                bit-identity differentials, skewed-morsel edge, pallas-
+#                inside-shard_map dispatch, collective accounting
+#                (tests/test_mesh_morsels.py); the GSPMD-compile-heavy
+#                SF0.01 oracle sweep keeps the slow marker and runs in
+#                the full `test` stage so this stage stays in budget
 #   test       - full pytest suite on an 8-virtual-device CPU mesh
 #   bench      - quick bench slice (SF 0.01) to catch perf regressions early
 #   all        - every stage in order
@@ -86,6 +93,14 @@ stage_kernels() {
         -q -m 'not slow')
 }
 
+stage_mesh() {
+    # sharded morsel execution: every streamed scan group dispatched over
+    # the virtual 8-device mesh must stay bit-identical to the single-chip
+    # path at every shard count (the conftest forces the device count)
+    (cd "$REPO" && python -m pytest tests/test_mesh_morsels.py \
+        -q -m 'not slow')
+}
+
 stage_test() {
     (cd "$REPO" && python -m pytest tests/ -q --durations=15)
 }
@@ -111,15 +126,15 @@ run_stage() {
 }
 
 case "${1:-all}" in
-    native|resilience|static|planner|kernels|test|bench)
+    native|resilience|static|planner|kernels|mesh|test|bench)
         run_stage "$1" ;;
     all)
         total0=$SECONDS
-        for s in native resilience static planner kernels test bench; do
+        for s in native resilience static planner kernels mesh test bench; do
             run_stage "$s"
         done
         echo "stage all: $((SECONDS - total0))s" ;;
-    --list)     echo "native resilience static planner kernels test bench all" ;;
-    *) echo "usage: run_ci.sh [native|resilience|static|planner|kernels|test|bench|all|--list]" >&2
+    --list)     echo "native resilience static planner kernels mesh test bench all" ;;
+    *) echo "usage: run_ci.sh [native|resilience|static|planner|kernels|mesh|test|bench|all|--list]" >&2
        exit 2 ;;
 esac

@@ -29,6 +29,20 @@ class EngineConfig:
     # device mesh axis for data-parallel table sharding
     mesh_shape: tuple[int, ...] = ()
     mesh_axis_names: tuple[str, ...] = ("shards",)
+    # multi-chip sharded morsel execution: partition every streamed scan
+    # group's morsels across this many data-parallel replicas of the device
+    # mesh ("shards" axis, parallel/mesh.make_mesh). Each morsel's packed
+    # upload lands row-sharded (NamedSharding; the narrow-lane buffer
+    # shards as equal per-replica payload blocks) and every replica runs
+    # the same compiled per-morsel program via shard_map on its rows, with
+    # device-local partial aggregation and ONE all_gather of the bounded
+    # decomposed partials before the existing host-side final merge.
+    # 0 / 1 = off: the single-chip path, bit-identical to before the knob
+    # existed. Only out-of-core streamed queries shard; in-core queries
+    # keep the single-chip (or mesh_shape/GSPMD) path. Virtual-device
+    # testing: XLA_FLAGS=--xla_force_host_platform_device_count=8.
+    # Property: nds.tpu.mesh_shards; runners expose --mesh_shards.
+    mesh_shards: int = 0
     # rows per morsel when streaming host->device. Sized to amortize the
     # tunnel RTT per dispatch (measured ~6 s/morsel at 1M rows, RTT-bound:
     # an SF100 scan is hundreds of morsels) while keeping the record pass

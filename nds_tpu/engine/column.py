@@ -172,6 +172,15 @@ class Table:
         idx = {n: i for i, n in enumerate(self.names)}
         return Table(list(names), [self.columns[idx[n]] for n in names])
 
+    def slice(self, lo: int, hi: int) -> "Table":
+        """Zero-copy row window [lo, hi) (numpy views; sharded morsel
+        staging partitions each morsel into per-replica row blocks)."""
+        return Table(self.names,
+                     [Column(c.dtype, np.asarray(c.data)[lo:hi],
+                             None if c.valid is None else c.valid[lo:hi],
+                             c.dictionary)
+                      for c in self.columns])
+
     def head(self, n: int) -> "Table":
         if self.num_rows <= n:
             return self

@@ -393,6 +393,17 @@ def pack_table(table: Table, capacity: Optional[int] = None,
 
 def _pack_table(table: Table, lanes: tuple, n: int,
                 cap: int) -> PackedTable:
+    payload, dicts = _pack_payload(table, lanes, n, cap)
+    return PackedTable(list(table.names), [c.dtype for c in table.columns],
+                       tuple(lanes), cap, jnp.asarray(payload), tuple(dicts))
+
+
+def _pack_payload(table: Table, lanes: tuple, n: int,
+                  cap: int) -> tuple[np.ndarray, list]:
+    """Host-side packed payload bytes (the PackedTable wire format) WITHOUT
+    the device upload: sharded morsel staging packs one payload per replica
+    row block and uploads the concatenation in a single row-sharded
+    device_put (shard_exec.stage_sharded)."""
     parts: list[np.ndarray] = []
     vparts: list[np.ndarray] = []
     dicts = []
@@ -433,8 +444,7 @@ def _pack_table(table: Table, lanes: tuple, n: int,
     vparts.append(np.packbits(alive, bitorder="little"))
     payload = np.concatenate(parts + vparts) if parts + vparts else \
         np.zeros(0, dtype=np.uint8)
-    return PackedTable(list(table.names), [c.dtype for c in table.columns],
-                       tuple(lanes), cap, jnp.asarray(payload), tuple(dicts))
+    return payload, dicts
 
 
 def _unpack_bits(seg: jax.Array, cap: int) -> jax.Array:

@@ -437,11 +437,22 @@ class JaxExecutor:
                  segment_cache_entries: int = 16,
                  scan_budget_bytes: int = 10 << 30,
                  params: Optional[tuple] = None,
-                 pallas_ops=frozenset()):
+                 pallas_ops=frozenset(),
+                 shard_local: bool = False):
         self._load_table = load_table
+        # shard-local mode (sharded morsel execution, shard_exec): this
+        # executor's trace runs INSIDE a shard_map body, one replica's rows
+        # at a time. Schedule-shaping gates behave like the mesh path (no
+        # data-dependent tier probes, no compaction — per-shard data would
+        # drift the recorded exact decisions), but execution strategies stay
+        # single-device (no in-plan collectives: the shard_map boundary IS
+        # the collective).
+        self._shard_local = bool(shard_local)
         # per-op Pallas kernel activation (EngineConfig.pallas_ops): off
-        # under a mesh — pack probes and shard_map partitioning assume the
-        # generic lowering there, and the measured target is single-chip
+        # under a GSPMD mesh — pack probes and in-plan shard_map
+        # partitioning assume the generic lowering there. Shard-LOCAL
+        # executors run the kernels: inside shard_map every operand is one
+        # replica's block, exactly the single-chip shapes the kernels tile.
         self._pallas_ops = frozenset() if mesh is not None \
             else _pallas.parse_ops(pallas_ops)
         # hoisted literal values for the in-flight execution: python scalars
@@ -1006,40 +1017,53 @@ class JaxExecutor:
                 return lowered.compile().as_text()
         return None
 
-    def record_plan(self, plan: PlanNode, params: tuple = ()):
+    def record_plan(self, plan: PlanNode, params: tuple = (),
+                    shard_local: bool = False):
         """Eager run that records the capacity schedule; returns
         (result, decisions, scan_keys). scan_keys keep FIRST-TOUCH order
         (plan-traversal order, stream-invariant) — sorting would let
         stream-specific segment fingerprints permute the compiled
-        program's argument order and break cross-stream HLO identity."""
+        program's argument order and break cross-stream HLO identity.
+
+        shard_local=True records the schedule a sharded-morsel replay will
+        consume (shard_exec.ShardedMorselQuery): the shard-local gates
+        apply for this call only, so the same session executor records
+        both single-chip and per-replica schedules."""
         from ...resilience import FAULTS
         FAULTS.fire("jax.execute")
         rec = _Recorder("record")
         self._rec = rec
         self._touched_scans = {}
         old_params = self._params
+        old_shard_local = self._shard_local
         self._params = params
+        self._shard_local = self._shard_local or shard_local
         try:
             out = self._eager(plan)
         finally:
             self._rec = None
             self._params = old_params
+            self._shard_local = old_shard_local
         return out, rec.decisions, tuple(self._touched_scans)
 
-    def record_plans(self, plans: list, params: tuple = ()):
+    def record_plans(self, plans: list, params: tuple = (),
+                     shard_local: bool = False):
         """Record several plans under ONE shared decision schedule (shared-
         scan fused morsel groups): the plans run in order with a single
         recorder, and the memo resets per plan exactly like the multi-plan
         replay in CompiledQuery._trace. Returns (outs, decisions,
         scan_keys) — scan_keys is the union in first-touch order across
-        plans, so the fused program's argument order is deterministic."""
+        plans, so the fused program's argument order is deterministic.
+        shard_local: see record_plan."""
         from ...resilience import FAULTS
         FAULTS.fire("jax.execute")
         rec = _Recorder("record")
         self._rec = rec
         self._touched_scans = {}
         old_params = self._params
+        old_shard_local = self._shard_local
         self._params = params
+        self._shard_local = self._shard_local or shard_local
         outs = []
         try:
             for p in plans:
@@ -1047,6 +1071,7 @@ class JaxExecutor:
         finally:
             self._rec = None
             self._params = old_params
+            self._shard_local = old_shard_local
         return outs, rec.decisions, tuple(self._touched_scans)
 
     def _load_columns(self, table: str, columns) -> Table:
@@ -1271,7 +1296,7 @@ class JaxExecutor:
         kernel (pack ranges are data-dependent reductions that would force
         GSPMD gathers)."""
         n = int(alive.shape[0])
-        if (self._mesh is None and key_data
+        if (self._mesh is None and not self._shard_local and key_data
                 and all(jnp.issubdtype(d.dtype, jnp.integer)
                         for d in key_data)):
             # the size cutoff is capacity-derived: replay must follow the
@@ -1346,12 +1371,15 @@ class JaxExecutor:
         count_t = t.count()
         count = self._decide_cap(count_t)
         cap = bucket(count)
-        if self._mesh is not None:
+        if self._mesh is not None or self._shard_local:
             # compaction is a global permutation (sort/cumsum/gather): under
             # SPMD it would force GSPMD to all-gather the sharded buffer.
             # Alive-masked ops stay shard-local, so larger masked capacities
             # beat rebuilding the table across the ICI. (The cap decision
             # above still records, keeping schedules mode-agnostic.)
+            # Shard-local replays skip it for the same schedule shape: the
+            # record pass sees one replica-sized slice, and a capacity-
+            # relative branch would drift per shard.
             return t
         if t.capacity <= 2 * cap:
             return t
@@ -1568,8 +1596,9 @@ class JaxExecutor:
         """Static gate for the sorted aggregation path: ONE key sort shared
         by every rollup prefix level, within-group scans instead of the
         serialized segment scatters, S-sized gathers for output assembly.
-        Single-device only (the mesh path has its own shard-local plan)."""
-        if self._mesh is not None:
+        Single-device only (the mesh path has its own shard-local plan, and
+        sharded-morsel replays must not re-probe per-shard key ranges)."""
+        if self._mesh is not None or self._shard_local:
             return False
         if not node.group_exprs:
             return False          # global aggregate: masked reduces suffice

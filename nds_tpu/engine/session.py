@@ -101,6 +101,31 @@ class Session:
         # shared_scan..., so a live-session toggle must not replay them.
         self._stream_cache: dict[str, Optional[dict]] = {}
         self._stream_cache_cfg: Optional[tuple] = None
+        # sharded morsel execution (config.mesh_shards): the data-parallel
+        # replica mesh streamed scan groups dispatch over, built lazily
+        self._morsel_mesh_obj = None
+
+    def _morsel_shards(self) -> int:
+        """Effective replica count for sharded morsel execution: 0 when the
+        knob is off (mesh_shards unset or 1) — the single-chip path then
+        runs bit-identically to before the knob existed."""
+        n = int(self.config.mesh_shards or 0)
+        return n if n > 1 else 0
+
+    def _morsel_mesh(self):
+        """The data-parallel "shards" mesh streamed morsels partition over
+        (parallel/mesh.make_mesh — the standalone primitives' mesh is now
+        the engine's entry point). Raises ValueError when the backend has
+        fewer devices than config.mesh_shards (for virtual-device testing
+        set XLA_FLAGS=--xla_force_host_platform_device_count)."""
+        n = self._morsel_shards()
+        if not n:
+            return None
+        if self._morsel_mesh_obj is None or \
+                self._morsel_mesh_obj.devices.size != n:
+            from ..parallel import make_mesh
+            self._morsel_mesh_obj = make_mesh(n)
+        return self._morsel_mesh_obj
 
     def _device_mesh(self):
         """Build the SPMD mesh from config.mesh_shape (None = single device).
@@ -447,8 +472,11 @@ class Session:
             from .jax_backend import pallas_kernels as _pk
             ops = sorted(_pk.parse_ops(self.config.pallas_ops))
             if self._device_mesh() is not None:
-                stats.pallas_fallback_reason = \
-                    "pallas_ops disabled under a device mesh"
+                # the GSPMD whole-plan mesh path still forces the XLA
+                # lowering (kernels are not partitionable operands); the
+                # sharded-MORSEL path (mesh_shards) runs them shard-local
+                # inside shard_map, so only mesh_shape lands here
+                stats.pallas_fallback_reason = "mesh"
             else:
                 stats.pallas_ops = ops
                 reason = _pk.fallback_reason()
@@ -481,6 +509,7 @@ class Session:
                 cfg.stream_fusion_max_branches, cfg.late_materialization,
                 cfg.late_mat_min_rows, cfg.decimal_physical, cfg.use_jax,
                 cfg.narrow_lanes, tuple(cfg.mesh_shape),
+                int(cfg.mesh_shards or 0),
                 tuple(sorted(cfg.pallas_ops)))
 
     def _sql_streaming(self, query: str):
@@ -550,6 +579,8 @@ class Session:
         re_records = 0
         bytes_uploaded = 0
         fused_groups = 0
+        sharded_groups = 0
+        shard_stats: dict = {}   # collective_bytes / collective_ms across groups
         morsels_per_table: dict[str, int] = {}
         prefetch_errs: list[str] = []
         from .plan import MaterializedNode
@@ -566,15 +597,16 @@ class Session:
         for group, gstate in zip(groups, sent["gstates"]):
             sinks = [(jobs[ji], partials[ji]) for ji, _bi in group.members]
             out = self._stream_group(group, sent["exec"], gstate, sinks,
-                                     prefetch_errs)
+                                     prefetch_errs, shard_stats)
             if out is None:
                 self._stream_cache[query] = None
                 return None     # not device-runnable: in-core path
-            morsels_run, rr, ub = out
+            morsels_run, rr, ub, sharded = out
             total_morsels += morsels_run
             re_records += rr
             bytes_uploaded += ub
             fused_groups += 1 if gstate["fused"] else 0
+            sharded_groups += 1 if sharded else 0
             morsels_per_table[group.table] = \
                 morsels_per_table.get(group.table, 0) + morsels_run
         for ji, job in enumerate(jobs):
@@ -631,6 +663,10 @@ class Session:
             narrow_lanes=bool(self.config.narrow_lanes),
             lane_spec={g.table: dict(zip(g.columns, g.lanes))
                        for g in groups if g.lanes is not None},
+            mesh_shards=self._morsel_shards() if sharded_groups else None,
+            sharded_groups=sharded_groups or None,
+            collective_bytes=shard_stats.get("collective_bytes"),
+            collective_ms=shard_stats.get("collective_ms"),
             prefetch_error_details=prefetch_errs,
             fallbacks=self.last_fallbacks))
         return result
@@ -685,7 +721,8 @@ class Session:
         return arrow_bridge.to_arrow(out)
 
     def _stream_group(self, group, shared: dict, state: dict,
-                      sinks: list, prefetch_errs: list):
+                      sinks: list, prefetch_errs: list,
+                      shard_stats: Optional[dict] = None):
         """Morsel loop for one shared-scan group: ONE morsel iterator and
         ONE double-buffered upload per morsel serve EVERY member branch (a
         worker thread packs + stages morsel i+1 while the device runs
@@ -702,8 +739,15 @@ class Session:
         host memory before any compaction ran). Worker-thread staging
         failures are recorded into `prefetch_errs` (the morsel restages
         synchronously — a silent degradation otherwise, ADVICE r5).
-        Returns (morsels, re_records, bytes_uploaded) or None when some
-        member is not device-runnable."""
+        With mesh_shards > 1 the group dispatches SHARDED: the staged
+        morsel upload lands row-sharded over the replica mesh (one
+        device_put of per-replica packed payload blocks), every replica
+        replays the same recorded per-morsel schedule on its rows inside
+        shard_map, and one all_gather moves the bounded decomposed
+        partials before the unchanged host merge
+        (jax_backend/shard_exec.ShardedMorselQuery). Returns (morsels,
+        re_records, bytes_uploaded, sharded) or None when some member is
+        not device-runnable."""
         import threading
 
         from . import streaming
@@ -714,6 +758,10 @@ class Session:
 
         morsel_rows = self.config.chunk_rows
         cap = bucket(morsel_rows)
+        n_shards = self._morsel_shards()
+        mesh = self._morsel_mesh() if n_shards else None
+        shard_cap = streaming.shard_capacity(morsel_rows, n_shards) \
+            if mesh is not None else None
         jexec, current = shared["jexec"], shared["current"]
         mkey = group.morsel_key
         morsels = self.iter_morsels(group.table, group.columns, morsel_rows)
@@ -725,6 +773,8 @@ class Session:
         bytes_uploaded = 0
 
         def record_first(morsel) -> bool:
+            if mesh is not None:
+                return record_first_sharded(morsel)
             current["table"] = morsel
             jexec.fallback_nodes = []
             if fuse:
@@ -762,10 +812,58 @@ class Session:
             state["fused"] = fuse
             return True
 
+        def record_first_sharded(morsel) -> bool:
+            """Record the per-REPLICA schedule on a representative shard-
+            sized slice of the first morsel (shard-local gates: no data-
+            dependent tier probes, so later replicas/morsels verify against
+            capacity bounds only) and build the shard_map-dispatched
+            ShardedMorselQuery program(s)."""
+            from .jax_backend.shard_exec import ShardedMorselQuery
+            spans = streaming.partition_morsel_rows(morsel.num_rows,
+                                                    n_shards)
+            current["table"] = morsel.slice(0, spans[0][1])
+            jexec.fallback_nodes = []
+            ops = jexec._pallas_ops
+            if fuse:
+                _o, decisions, scan_keys = jexec.record_plans(
+                    group.plans, shard_local=True)
+                if jexec.fallback_nodes:
+                    return False
+                decisions = streaming.inflate_schedule(decisions, shard_cap)
+                state["cqs"] = [ShardedMorselQuery(
+                    list(group.plans), decisions, scan_keys, mesh, mkey,
+                    label=f"{self._active_label}/morsel:{group.table}",
+                    pallas_ops=ops)]
+                state["ents"] = [{"scan_keys": scan_keys}]
+            else:
+                cqs, ents = [], []
+                for bi, p in enumerate(group.plans):
+                    _o, decisions, scan_keys = jexec.record_plan(
+                        p, shard_local=True)
+                    if jexec.fallback_nodes:
+                        return False
+                    decisions = streaming.inflate_schedule(decisions,
+                                                           shard_cap)
+                    cqs.append(ShardedMorselQuery(
+                        p, decisions, scan_keys, mesh, mkey,
+                        label=f"{self._active_label}/morsel:"
+                              f"{group.table}#{bi}",
+                        pallas_ops=ops))
+                    ents.append({"scan_keys": scan_keys})
+                state["cqs"], state["ents"] = cqs, ents
+            state["fused"] = fuse
+            return True
+
         def stage(morsel):
             """Pack + upload one union-column morsel into a fresh buffer
             (group.lanes = the static narrow-lane spec; None = legacy wide
-            layout under --no_narrow_lanes)."""
+            layout under --no_narrow_lanes). Sharded mode uploads the same
+            payload row-sharded over the replica mesh instead."""
+            if mesh is not None:
+                from .jax_backend.shard_exec import stage_sharded
+                sub = morsel.select(group.columns)
+                return stage_sharded(sub, mesh, shard_cap,
+                                     lanes=group.lanes)
             with TRACER.span("morsel.stage", cat="upload",
                              table=group.table, rows=morsel.num_rows):
                 sub = morsel.select(group.columns)
@@ -778,11 +876,12 @@ class Session:
             dispatch, or per-member dispatches. Returns member outputs in
             group.plans order."""
             nonlocal re_records
+            kw = {} if mesh is None else {"stats": shard_stats}
             try:
                 if state["fused"]:
                     return list(state["cqs"][0].run(
-                        jexec._scans_for(state["ents"][0])))
-                return [cq.run(jexec._scans_for(ent))
+                        jexec._scans_for(state["ents"][0]), **kw))
+                return [cq.run(jexec._scans_for(ent), **kw)
                         for cq, ent in zip(state["cqs"], state["ents"])]
             except ReplayMismatch:
                 # a morsel genuinely exceeded the inflated schedule: run
@@ -853,7 +952,7 @@ class Session:
             current.pop("table", None)
         if count == 0:
             return None   # empty source: the in-core path handles it
-        return count, re_records, bytes_uploaded
+        return count, re_records, bytes_uploaded, mesh is not None
 
     def sql_arrow(self, query: str) -> pa.Table:
         return arrow_bridge.to_arrow(self.sql(query))

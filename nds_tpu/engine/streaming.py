@@ -767,6 +767,27 @@ def rebuild_above(path: list[PlanNode], new_agg_out: PlanNode) -> PlanNode:
     return node
 
 
+def partition_morsel_rows(num_rows: int, n_shards: int
+                          ) -> list[tuple[int, int]]:
+    """Contiguous per-replica row spans [(lo, hi), ...] of one morsel for
+    sharded morsel execution: ceil-balanced blocks, trailing replicas may
+    be empty (a skewed last morsel smaller than the shard count leaves
+    whole replicas with zero alive rows — the compiled per-morsel program
+    handles the all-dead block like any filtered-empty morsel)."""
+    per = -(-num_rows // n_shards) if num_rows else 0
+    return [(min(k * per, num_rows), min((k + 1) * per, num_rows))
+            for k in range(n_shards)]
+
+
+def shard_capacity(morsel_rows: int, n_shards: int) -> int:
+    """Per-replica padded row capacity: the morsel bound split n ways and
+    re-bucketed, so every replica's block is a ladder capacity and the
+    row-sharded upload divides the device buffer evenly (total staged
+    capacity = shard_capacity * n_shards >= bucket(morsel_rows))."""
+    from .jax_backend.device import bucket
+    return bucket(-(-bucket(morsel_rows) // n_shards))
+
+
 def inflate_schedule(decisions: list, morsel_cap: int) -> list:
     """Round every capacity decision up to the morsel bound so ONE compiled
     program serves every morsel (filters/joins against unique dimension keys

@@ -59,6 +59,15 @@ class ExecStats:
     morsels_per_table: Optional[dict] = None
     narrow_lanes: Optional[bool] = None
     lane_spec: Optional[dict] = None
+    # -- sharded morsel execution (EngineConfig.mesh_shards) -----------------
+    #: data-parallel replica count the streamed groups ran on (None = off)
+    mesh_shards: Optional[int] = None
+    #: scan groups whose morsels actually dispatched over the mesh
+    sharded_groups: Optional[int] = None
+    #: per-device ingress of the per-morsel partial all_gathers (ring model)
+    collective_bytes: Optional[int] = None
+    #: measured wall of the partial-gather dispatches
+    collective_ms: Optional[float] = None
     # -- pallas kernels (EngineConfig.pallas_ops) ----------------------------
     #: the validated op subset active for this execution (None = flag off)
     pallas_ops: Optional[list] = None
@@ -92,7 +101,11 @@ class ExecStats:
                   morsels_per_table: dict, narrow_lanes: bool,
                   lane_spec: dict,
                   prefetch_error_details: Optional[list] = None,
-                  fallbacks: Optional[list] = None) -> "ExecStats":
+                  fallbacks: Optional[list] = None,
+                  mesh_shards: Optional[int] = None,
+                  sharded_groups: Optional[int] = None,
+                  collective_bytes: Optional[int] = None,
+                  collective_ms: Optional[float] = None) -> "ExecStats":
         """Typed record of one out-of-core (morsel-streamed) execution."""
         return cls(mode="streaming", jobs=jobs, morsels=morsels,
                    morsel_rows=morsel_rows, re_records=re_records,
@@ -102,6 +115,9 @@ class ExecStats:
                    fused_groups=fused_groups, bytes_uploaded=bytes_uploaded,
                    morsels_per_table=dict(morsels_per_table),
                    narrow_lanes=narrow_lanes, lane_spec=dict(lane_spec),
+                   mesh_shards=mesh_shards, sharded_groups=sharded_groups,
+                   collective_bytes=collective_bytes,
+                   collective_ms=collective_ms,
                    prefetch_error_details=list(prefetch_error_details or ()),
                    fallback_reasons=list(fallbacks or ()))
 
@@ -119,7 +135,9 @@ class ExecStats:
                   "re_records", "shared_scan", "scan_passes",
                   "tables_streamed", "branches_served", "fused_groups",
                   "bytes_uploaded", "morsels_per_table", "narrow_lanes",
-                  "lane_spec", "pallas_ops", "pallas_fallback_reason"):
+                  "lane_spec", "mesh_shards", "sharded_groups",
+                  "collective_bytes", "collective_ms",
+                  "pallas_ops", "pallas_fallback_reason"):
             v = getattr(self, k)
             if v is not None:
                 out[k] = v

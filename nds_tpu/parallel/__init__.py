@@ -9,8 +9,8 @@ TPU-native equivalents here ride XLA collectives over ICI/DCN:
 - psum / psum_scatter == partial-aggregate merge
 - row-sharded arrays over a Mesh == table partitions across executors
 """
-from .mesh import make_mesh, shard_spec  # noqa: F401
+from .mesh import make_mesh, replicated_spec, shard_spec  # noqa: F401
 from .dist_ops import (  # noqa: F401
-    shard_rows, broadcast_join_aggregate, repartition_by_key,
-    distributed_aggregate,
+    shard_rows, broadcast_join_aggregate, gather_partials,
+    repartition_by_key, distributed_aggregate,
 )

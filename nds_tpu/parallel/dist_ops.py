@@ -138,6 +138,24 @@ def repartition_by_key(mesh: Mesh, per_pair_capacity: int,
                      out_specs=(P(axis), P(axis), P(axis), P()))
 
 
+def gather_partials(mesh: Mesh):
+    """Jittable all_gather of a row-sharded pytree of per-replica partial
+    blocks into a replicated concatenation (tiled: shard k's rows land at
+    block k). The engine's sharded morsel path dispatches this as its ONE
+    collective per morsel: device-local partial aggregates are bounded
+    (group-cardinality-sized), so only the decomposed partials ride the
+    ICI before the existing host-side final merge
+    (jax_backend/shard_exec.ShardedMorselQuery)."""
+    axis = mesh.axis_names[0]
+
+    def local(tree):
+        return jax.tree_util.tree_map(
+            lambda x: lax.all_gather(x, axis, tiled=True), tree)
+
+    return shard_map(local, mesh=mesh, in_specs=(P(axis),), out_specs=P(),
+                     check_vma=False)
+
+
 def _local_join_ranges(lkd, lal, rkd, ral):
     """Per-shard probe ranges for a co-partitioned join block (the generic
     sort-based machinery, shard-local): returns (lo, cnt, perm_r)."""

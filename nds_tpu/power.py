@@ -171,6 +171,7 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
                      narrow_lanes: bool | None = None,
                      verify_plans: str | None = None,
                      pallas_ops: str | None = None,
+                     mesh_shards: int | None = None,
                      trace: str | None = None
                      ) -> list[tuple[str, int, int, int]]:
     """Run every query in the stream; returns (name, start_ms, end_ms, ms).
@@ -207,6 +208,11 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
     pallas_ops: comma list of {sort,groupby,gather} enabling the TPU
     Pallas kernel for that op family (None = take EngineConfig.pallas_ops;
     results are bit-identical to the XLA lowering either way).
+    mesh_shards: partition every streamed scan group's morsels across this
+    many data-parallel mesh replicas (shard_map per-morsel programs +
+    one partial all_gather; None = take EngineConfig.mesh_shards, 0/1 =
+    the unchanged single-chip path). Only out-of-core streamed queries
+    shard; in-core queries stay single-chip.
     verify_plans: static plan-IR verification mode (off|final|per-pass,
     engine/verify.py) — None takes EngineConfig.verify_plans.
     trace: enable the obs span tracer for the whole stream and write a
@@ -236,6 +242,8 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
     if pallas_ops is not None:   # --pallas_ops A/B override
         config.pallas_ops = tuple(
             x.strip() for x in pallas_ops.split(",") if x.strip())
+    if mesh_shards is not None:  # --mesh_shards override
+        config.mesh_shards = mesh_shards
     session = Session(config)
     setup_tables(session, input_prefix, input_format)
 
@@ -509,6 +517,16 @@ def main(argv: list[str] | None = None) -> int:
                         "backends kernels run in interpret mode (cpu) or "
                         "fall back with pallas_fallback_reason recorded; "
                         "property: nds.tpu.pallas_ops")
+    p.add_argument("--mesh_shards", type=int, default=None, metavar="N",
+                   help="multi-chip sharded morsel execution: partition "
+                        "every streamed scan group's morsels across N "
+                        "data-parallel replicas of the device mesh "
+                        "(shard_map per-morsel programs, one partial "
+                        "all_gather per morsel); 0/1 = single-chip, "
+                        "bit-identical to leaving it unset; property: "
+                        "nds.tpu.mesh_shards. Virtual-device testing: "
+                        "XLA_FLAGS=--xla_force_host_platform_device_"
+                        "count=N")
     p.add_argument("--trace", default=None, metavar="PATH",
                    help="enable engine span tracing for the whole stream "
                         "and write a Chrome trace-event file here (opens "
@@ -530,6 +548,7 @@ def main(argv: list[str] | None = None) -> int:
                      narrow_lanes=False if a.no_narrow_lanes else None,
                      verify_plans=a.verify_plans,
                      pallas_ops=a.pallas_ops,
+                     mesh_shards=a.mesh_shards,
                      trace=a.trace)
     return 0
 

@@ -144,6 +144,11 @@ def _parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--mesh_record", default=None, metavar="PATH",
                    help="also write the mesh scaling table as a standalone "
                         "MULTICHIP_r*.json-style record to PATH")
+    p.add_argument("--no_encoded", action="store_true",
+                   help="disable encoded execution (dictionary/RLE wire "
+                        "encodings, EngineConfig.encoded_exec) for A/B "
+                        "upload-volume runs; equivalent to "
+                        "NDS_TPU_BENCH_ENCODED=0")
     return p.parse_args(argv)
 
 
@@ -218,6 +223,10 @@ def main(argv=None) -> None:
     # (bytes_uploaded is 0 for device-resident in-core queries)
     config.narrow_lanes = os.environ.get(
         "NDS_TPU_BENCH_NARROW", "1").lower() not in ("0", "false", "no")
+    # NDS_TPU_BENCH_ENCODED=0 / --no_encoded: plain narrow-lane layout
+    # (encoded execution off) for the dictionary/RLE A/B acceptance runs
+    config.encoded_exec = not args.no_encoded and os.environ.get(
+        "NDS_TPU_BENCH_ENCODED", "1").lower() not in ("0", "false", "no")
     ooc_min = os.environ.get("NDS_TPU_BENCH_OOC_MIN_ROWS")
     if ooc_min:
         config.out_of_core_min_rows = int(ooc_min)
@@ -244,6 +253,7 @@ def main(argv=None) -> None:
     exec_modes: dict[str, str] = {}
     fallback_reasons: dict[str, list] = {}
     attribution: dict[str, float] = {}
+    encodings: dict[str, dict] = {}
     for name in units:
         sql = query_dict[name]
         # untimed oracle warm run: the first execution pays the lazy parquet
@@ -287,6 +297,20 @@ def main(argv=None) -> None:
         # queries upload nothing in steady state (device-resident scans)
         upload_bytes[name] = session.last_exec_stats.get("bytes_uploaded", 0)
         exec_modes[name] = session.last_exec_stats.get("mode", "in-core")
+        if session.last_exec_stats.get("enc_spec") is not None:
+            # the encoded-execution evidence block: which encoding each
+            # streamed column rode, the bytes the encodings removed vs the
+            # plain narrow-lane layout, and how often values actually
+            # materialized (decode sites; steady-state replays decode 0)
+            st = session.last_exec_stats
+            encodings[name] = {
+                "spec": st["enc_spec"],
+                "bytes_saved": st.get("enc_bytes_saved", 0),
+                "bytes_uploaded": st.get("bytes_uploaded", 0),
+                "decode_sites": st.get("decode_sites", 0),
+                "decode_rows": st.get("decode_rows", 0),
+                "host_decode_ms": st.get("host_decode_ms"),
+            }
         if session.last_exec_stats.get("fallback_reasons"):
             fallback_reasons[name] = \
                 list(session.last_exec_stats["fallback_reasons"])
@@ -340,6 +364,11 @@ def main(argv=None) -> None:
         # the host — the per-run enumeration of non-device work
         "exec_modes": exec_modes,
         "fallback_reasons": fallback_reasons,
+        # encoded execution (EngineConfig.encoded_exec / --no_encoded):
+        # per-query chosen encoding specs + bytes saved + decode counts;
+        # {} when off or nothing streams
+        "encoded": bool(config.encoded_exec),
+        "encodings": encodings,
         # the Pallas kernel configuration this run measured (ops enabled,
         # platform mode, and the degradation reason when the XLA lowering
         # served despite the flag)

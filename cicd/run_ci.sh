@@ -20,6 +20,15 @@
 #                early and cheaply; the obs suite gates here because the
 #                tracer/metrics hooks thread through the same session/
 #                streaming paths
+#   encoded    - encoded execution tier-1 (fast differentials): the
+#                dictionary/RLE pack/unpack property round trip, streamed
+#                on/off bit-identity + numpy-oracle differentials,
+#                code-space filter/join/group-by evidence (decode-site
+#                counts), verifier "encoding" findings, the sharded
+#                (mesh_shards=2) encoded round trip, and the encoding-
+#                stats sources (arrow/parquet/view/warehouse-manifest);
+#                the SF0.01 SQLite-oracle slice carries the slow marker
+#                and runs in the full `test` stage
 #   kernels    - Pallas kernel suite in INTERPRET mode (JAX_PLATFORMS=cpu
 #                exercises the real kernel bodies of
 #                engine/jax_backend/pallas_kernels.py): kernel-vs-XLA
@@ -85,6 +94,15 @@ stage_planner() {
         tests/test_obs.py -q)
 }
 
+stage_encoded() {
+    # encoded execution: every streamed scan group's dictionary/RLE wire
+    # layout must stay bit-identical to the plain narrow-lane path, with
+    # joins/group-bys provably running on codes (decode-site counts) and
+    # encoding specs proven against recorded stats before a morsel ships
+    (cd "$REPO" && python -m pytest tests/test_encoded_exec.py \
+        -q -m 'not slow')
+}
+
 stage_kernels() {
     # Pallas interpret-mode suite: the real kernel code paths (tiled
     # bitonic sort, fused group-by partials, VMEM-staged gather) proven
@@ -126,15 +144,16 @@ run_stage() {
 }
 
 case "${1:-all}" in
-    native|resilience|static|planner|kernels|mesh|test|bench)
+    native|resilience|static|planner|encoded|kernels|mesh|test|bench)
         run_stage "$1" ;;
     all)
         total0=$SECONDS
-        for s in native resilience static planner kernels mesh test bench; do
+        for s in native resilience static planner encoded kernels mesh \
+                 test bench; do
             run_stage "$s"
         done
         echo "stage all: $((SECONDS - total0))s" ;;
-    --list)     echo "native resilience static planner kernels mesh test bench all" ;;
-    *) echo "usage: run_ci.sh [native|resilience|static|planner|kernels|mesh|test|bench|all|--list]" >&2
+    --list)     echo "native resilience static planner encoded kernels mesh test bench all" ;;
+    *) echo "usage: run_ci.sh [native|resilience|static|planner|encoded|kernels|mesh|test|bench|all|--list]" >&2
        exit 2 ;;
 esac

@@ -87,6 +87,21 @@ class EngineConfig:
     # runner exposes --no_narrow_lanes restoring the wide int64 layout
     # bit-identically for A/B runs.
     narrow_lanes: bool = True
+    # encoded execution end-to-end (the narrow-lane machinery generalized
+    # from width to ENCODING, device.plan_encodings): low-cardinality
+    # int/date/decimal columns upload as dictionary CODES on u8/u16 lanes
+    # plus a once-per-group host codebook, and clustered columns upload as
+    # (value, run-length) pairs expanded on device — chosen statically per
+    # scan group from per-table cardinality/run stats
+    # (Session.column_enc_stats). Execution stays on codes where legality
+    # allows (equality/IN filters remap literals through the dictionary at
+    # trace time, join/group keys factorize codes directly, sorts ride the
+    # order-preserving dictionary); device.decode_col materializes values
+    # only at arithmetic/aggregate/output sites. Bit-identical on/off;
+    # requires narrow_lanes (encodings extend the packed layout). Property:
+    # nds.tpu.encoded_exec; the power runner exposes --no_encoded_exec and
+    # bench.py reads NDS_TPU_BENCH_ENCODED for A/B runs.
+    encoded_exec: bool = True
     # late materialization for join-heavy aggregates (planner.
     # _late_materialization): group by the dimension's surrogate join key and
     # gather dimension attributes AFTER aggregation instead of materializing

@@ -243,6 +243,119 @@ def parquet_column_stats(paths, dec_as_int: bool = False) -> dict:
     return agg
 
 
+# -- column encoding stats (encoded-execution planning) -----------------------
+# Cardinality (the sorted distinct-value set, capped) and total run count
+# per column, in ENGINE units. device.plan_encodings chooses per-column
+# dictionary/RLE wire encodings from these ONCE per scan group, exactly
+# like plan_lanes does from the (lo, hi) range stats above. The run count
+# is a BOUND for any contiguous morsel window of the same data in the same
+# order, so the static per-morsel run capacity derived from it can never
+# overflow while the stats hold.
+
+#: distinct values above this are not collected (no dictionary encoding)
+ENC_MAX_CARD = 1 << 16
+
+
+def column_enc_stat(col, dec_as_int: bool = False,
+                    max_card: int = ENC_MAX_CARD):
+    """{"distinct": sorted int array or None, "runs": int, "rows": n} for
+    one arrow column (int/date/decimal only; None otherwise). `distinct`
+    covers VALID values (null slots ride canonical code 0); `runs` counts
+    over null-filled-with-zero values — the exact canonicalization
+    pack-time RLE runs over."""
+    arr = _chunked_to_array(col)
+    t = arr.type
+    if not (pa.types.is_integer(t) or pa.types.is_date(t)
+            or (pa.types.is_decimal(t) and dec_as_int)):
+        return None
+    c = from_arrow_column(arr, dec_as_int)   # engine units, nulls -> 0
+    return column_enc_stat_values(np.asarray(c.data), c.validity, max_card)
+
+
+def column_enc_stat_values(data: np.ndarray, valid: np.ndarray,
+                           max_card: int = ENC_MAX_CARD) -> dict:
+    """Encoding stats over an already-engine-unit value array."""
+    filled = np.where(valid, data, np.zeros((), dtype=data.dtype))
+    n = int(len(filled))
+    runs = int(np.count_nonzero(filled[1:] != filled[:-1]) + 1) if n else 0
+    distinct = None
+    u = np.unique(data[valid])
+    if len(u) <= max_card:
+        distinct = u.astype(np.int64)
+    return {"distinct": distinct, "runs": runs, "rows": n}
+
+
+def merge_enc_stats(parts: list) -> "dict | None":
+    """Combine per-source encoding stats (per warehouse file, per chunk):
+    distinct = the union (None when any part lacks it), runs = the sum —
+    a window spanning source boundaries holds at most the per-source run
+    totals combined, under ANY source order."""
+    if not parts or any(p is None for p in parts):
+        return None
+    distinct = None
+    if all(p.get("distinct") is not None for p in parts):
+        distinct = np.unique(np.concatenate(
+            [np.asarray(p["distinct"], dtype=np.int64) for p in parts]))
+        if len(distinct) > ENC_MAX_CARD:
+            distinct = None
+    return {"distinct": distinct,
+            "runs": sum(int(p["runs"]) for p in parts),
+            "rows": sum(int(p.get("rows", 0)) for p in parts)}
+
+
+# -- parquet dictionary pass-through (staging-thread hot loop) ----------------
+
+def parquet_dictionary_columns(paths) -> list[str]:
+    """String columns dictionary-encoded in EVERY column chunk of every
+    row group of the given parquet files (metadata only, no data read).
+    Reading these with ParquetReadOptions(dictionary_columns=...) hands
+    the staging thread codes + dictionary directly — from_arrow_column
+    then skips its dictionary_encode() re-encoding pass, the hot loop of
+    double-buffered morsel staging."""
+    import pyarrow.parquet as pq
+
+    cand = None
+    for path in paths:
+        try:
+            meta = pq.read_metadata(path)
+            schema = pq.read_schema(path)
+        except Exception:
+            return []
+        strs = {f.name for f in schema
+                if pa.types.is_string(f.type)
+                or pa.types.is_large_string(f.type)}
+        cand = strs if cand is None else (cand & strs)
+        names = meta.schema.names
+        for rg in range(meta.num_row_groups):
+            group = meta.row_group(rg)
+            for ci in range(group.num_columns):
+                name = names[ci]
+                if name not in cand:
+                    continue
+                encs = set(group.column(ci).encodings)
+                if not (encs & {"PLAIN_DICTIONARY", "RLE_DICTIONARY"}):
+                    cand.discard(name)
+    return sorted(cand or ())
+
+
+def parquet_dataset_format(paths):
+    """A pyarrow dataset format that reads the (fully) dictionary-encoded
+    string columns of `paths` as dictionary arrays — zero-copy code
+    pass-through for the staging thread. None when nothing qualifies or
+    the pyarrow version lacks the option."""
+    import pyarrow.dataset as pa_dataset
+
+    cols = parquet_dictionary_columns(paths)
+    if not cols:
+        return None
+    try:
+        return pa_dataset.ParquetFileFormat(
+            read_options=pa_dataset.ParquetReadOptions(
+                dictionary_columns=cols))
+    except Exception:
+        return None
+
+
 def _dedupe(names: list[str]) -> list[str]:
     seen: dict[str, int] = {}
     out = []

@@ -68,12 +68,22 @@ class DCol:
 
     Invariant: slots that are null (or dead rows) hold canonical zeros so
     grouping/sorting kernels see deterministic payloads.
+
+    `codebook` (encoded execution): when set, `data` holds int32 CODES
+    indexing this host-side SORTED array of engine-unit values (int/date/
+    decN columns dictionary-encoded on the wire). The sorted order makes
+    codes order-isomorphic to values, so filters, join keys, group keys and
+    sorts run directly on the codes; `decode_col` materializes values only
+    at arithmetic/aggregate/output sites (the generalization of the
+    narrow-lane `widen_col` deferral from width to encoding). Null slots
+    hold code 0 with valid=False, exactly like plain columns hold value 0.
     """
     dtype: str                 # logical: int | float | bool | date | str
     data: jax.Array
     valid: jax.Array           # bool, same length
     dictionary: Optional[np.ndarray] = None  # host object array for "str"
     parts: Optional[tuple] = None  # compound string: tuple[DCol] (lazy concat)
+    codebook: Optional[np.ndarray] = None  # sorted engine-unit values
 
     def __len__(self) -> int:
         return int(self.data.shape[0])
@@ -117,13 +127,43 @@ class _ById:
         return isinstance(other, _ById) and other.obj is self.obj
 
 
+class _ByIds:
+    """Element-identity-hashed wrapper for a TUPLE of host objects.
+
+    PackedTable aux carries per-column host arrays (dictionaries,
+    codebooks) in a tuple rebuilt on every pack; hashing the TUPLE by
+    identity (_ById) made every morsel a fresh jit cache key — the
+    compiled per-morsel program re-traced morsel after morsel even though
+    the actual host objects (None slots, group-stable codebooks) never
+    changed. Hashing by the ELEMENT identities keeps one cache entry per
+    actual layout. The wrapper keeps the objects referenced, so their ids
+    cannot be recycled while a cache key is alive."""
+    __slots__ = ("objs", "_ids")
+
+    def __init__(self, objs):
+        self.objs = tuple(objs) if objs is not None else None
+        self._ids = None if self.objs is None else \
+            tuple(id(o) for o in self.objs)
+
+    def __hash__(self):
+        return hash(self._ids)
+
+    def __eq__(self, other):
+        return isinstance(other, _ByIds) and other._ids == self._ids
+
+    @property
+    def obj(self):
+        return self.objs
+
+
 def _dcol_flatten(c: DCol):
-    return (c.data, c.valid, c.parts), (c.dtype, _ById(c.dictionary))
+    return (c.data, c.valid, c.parts), (c.dtype, _ById(c.dictionary),
+                                        _ById(c.codebook))
 
 
 def _dcol_unflatten(aux, children):
     data, valid, parts = children
-    return DCol(aux[0], data, valid, aux[1].obj, parts)
+    return DCol(aux[0], data, valid, aux[1].obj, parts, aux[2].obj)
 
 
 def _dtable_flatten(t: DTable):
@@ -323,6 +363,185 @@ class LaneOverflowError(ValueError):
     rewrite bug) — surfaced loudly instead of wrapping silently."""
 
 
+class EncodingOverflowError(ValueError):
+    """A column's data violates its declared encoding spec — a value not in
+    the planned dictionary, or more runs than the planned run capacity.
+    Encoding specs are proven against recorded table stats (the verifier's
+    "encoding" findings), so this means stats drift or a planner bug, and
+    it surfaces loudly instead of shipping a wrong morsel."""
+
+
+# -- encoded execution: per-column wire encodings -----------------------------
+# The narrow-lane machinery generalized from *width* to *encoding*: a packed
+# column may additionally ride one of
+#
+#   enc              wire layout (data section)             device view
+#   "plain"          lane bytes * cap (the lane table)      values
+#   ("dict", card)   CODE-lane bytes * cap; the sorted      i32 codes +
+#                    value dictionary (codebook) stays      host codebook
+#                    host-side, uploaded once per group     (DCol.codebook)
+#   ("rle", runs)    value-lane bytes * runs_cap + i32      values (expanded
+#                    run lengths * runs_cap                 on device)
+#
+# Encodings are chosen STATICALLY per scan group from per-table stats
+# (cardinality for dict, total run count for rle — Session.column_enc_stats)
+# so every morsel of a pass shares one compiled layout. Dictionary codebooks
+# are SORTED, making codes order-isomorphic to values: execution stays on
+# codes through filters/joins/group-bys/sorts and decodes per-site via
+# decode_col. RLE expands at unpack (jnp.repeat with a static total), so it
+# is purely wire compression — the unpacked arrays are bit-identical to the
+# plain lane's. `runs` is the table-wide run-count BOUND: any contiguous
+# morsel window holds at most that many runs, so the per-morsel run
+# capacity derived from it can never overflow while the stats hold.
+
+def _runs_cap(runs_bound: int, cap: int) -> int:
+    """Static per-morsel run capacity for an RLE column: the table-wide
+    bound (+1 for the capacity-pad run) bucketed, never above cap (every
+    row its own run is always representable)."""
+    return min(bucket(max(int(runs_bound) + 1, 8)), cap)
+
+
+def enc_rows_bytes(lane: str, enc, cap: int) -> int:
+    """Wire bytes of one column's data section under its encoding."""
+    if isinstance(enc, tuple) and enc[0] == "rle":
+        rc = _runs_cap(enc[1], cap)
+        return _LANE_WIRE[lane] * rc + 4 * rc      # values + i32 lengths
+    return _lane_rows_bytes(lane, cap)             # plain / dict codes
+
+
+def _code_lane(card: int) -> Optional[str]:
+    if card <= _LANE_BOUNDS["u8"][1] + 1:
+        return "u8"
+    if card <= _LANE_BOUNDS["u16"][1] + 1:
+        return "u16"
+    return None
+
+
+def plan_encodings(dtypes: list, lanes: tuple, enc_stats: list,
+                   cap_rows: int) -> Optional[tuple]:
+    """Choose per-column encodings for a scan group from cardinality/run
+    stats. `lanes` is the plan_lanes value-lane spec; `enc_stats[i]` is
+    {"distinct": sorted np engine-unit array or None, "runs": int or None}
+    or None (no stats -> plain, always safe). Returns
+    (encs, wire_lanes, codebooks) — wire_lanes replaces dict columns' value
+    lane with their code lane — or None when every column stays plain."""
+    encs: list = []
+    out_lanes: list = []
+    books: list = []
+    cap = bucket(max(int(cap_rows), 8))
+    any_enc = False
+    for dt, lane, st in zip(dtypes, lanes, enc_stats or [None] * len(lanes)):
+        choice = ("plain", lane, None)
+        if st and lane not in ("b1",) and dt not in ("str", "bool"):
+            width = _LANE_WIRE[lane]
+            best = width * cap                      # plain cost to beat
+            dv = st.get("distinct")
+            if dv is not None and dt != "float":
+                dv = np.asarray(dv)
+                book = dv.astype(np.int64 if lane == "i64" else np.int32)
+                if len(book) == 0:
+                    book = np.zeros(1, dtype=book.dtype)
+                clane = _code_lane(len(book))
+                if clane is not None and _LANE_WIRE[clane] < width:
+                    cost = _LANE_WIRE[clane] * cap
+                    if cost < best:
+                        best = cost
+                        choice = (("dict", len(book)), clane, book)
+            runs = st.get("runs")
+            if runs is not None:
+                cost = enc_rows_bytes(lane, ("rle", int(runs)), cap)
+                # rle must beat both plain and the dict candidate by 2x:
+                # marginal savings don't earn the expansion pass
+                if cost * 2 <= best:
+                    choice = (("rle", int(runs)), lane, None)
+        encs.append(choice[0])
+        out_lanes.append(choice[1])
+        books.append(choice[2])
+        any_enc = any_enc or choice[0] != "plain"
+    if not any_enc:
+        return None
+    return tuple(encs), tuple(out_lanes), tuple(books)
+
+
+def enc_lane_bytes(lanes: tuple, cap: int, encs: Optional[tuple]) -> int:
+    """lane_bytes generalized over encodings (None = all plain)."""
+    if encs is None:
+        return lane_bytes(lanes, cap)
+    return sum(enc_rows_bytes(ln, e, cap) for ln, e in zip(lanes, encs)) + \
+        (len(lanes) + 1) * ((cap + 7) // 8)
+
+
+# -- device codebook cache (satellite: once-per-group dictionary upload) ------
+# decode sites gather through the device copy of a group's codebook; the
+# codebook object is morsel-invariant for a scan group, so the upload
+# happens once and every later decode (and every later morsel's eager
+# re-record) reuses it — counted via obs/metrics dict_uploads_saved.
+
+_BOOK_CACHE: dict = {}          # id(book) -> (pinned np array, device array)
+_BOOK_CACHE_MAX = 256
+
+# decode-site observability: how many decode_col calls actually decoded,
+# and how many column slots they materialized — the "execution stays on
+# codes" evidence (a group key that never decodes at morsel scale shows up
+# as decode_rows << morsels * capacity)
+_DECODE_STATS = {"sites": 0, "rows": 0}
+
+
+def decode_stats() -> dict:
+    return dict(_DECODE_STATS)
+
+
+def _codebook_device(book: np.ndarray) -> jax.Array:
+    ent = _BOOK_CACHE.get(id(book))
+    if ent is not None and ent[0] is book:
+        from ...obs import metrics as _metrics
+        _metrics.DICT_UPLOADS_SAVED.inc()
+        return ent[1]
+    if len(_BOOK_CACHE) >= _BOOK_CACHE_MAX:
+        _BOOK_CACHE.clear()
+    # the upload must happen OUTSIDE any live trace: a traced constant
+    # would be a tracer, and caching a tracer across programs leaks it
+    with jax.ensure_compile_time_eval():
+        dev = jnp.asarray(book)
+    _BOOK_CACHE[id(book)] = (book, dev)
+    return dev
+
+
+def decode_col(c: DCol) -> DCol:
+    """Materialize an encoded column's values: codes gather through the
+    device-resident codebook (null/dead slots stay canonical zeros). The
+    per-site decode seam — callers are the sites that genuinely need
+    values: arithmetic/aggregate arguments, cross-codebook comparisons,
+    and output materialization. Everything else (filters via trace-time
+    literal remap, join keys, group keys, sorts) runs on the codes."""
+    if c.codebook is None:
+        return c
+    book = _codebook_device(c.codebook)
+    safe = jnp.clip(c.data, 0, book.shape[0] - 1)
+    data = jnp.where(c.valid, book[safe], jnp.zeros((), book.dtype))
+    _DECODE_STATS["sites"] += 1
+    _DECODE_STATS["rows"] += int(c.data.shape[0])
+    from ...obs import metrics as _metrics
+    _metrics.DECODE_SITES.inc()
+    return replace(c, data=data, codebook=None)
+
+
+def encode_against(book: np.ndarray, c: DCol) -> jax.Array:
+    """Map a PLAIN column's values into another column's code space: the
+    exact code where the value is in the codebook, -1 (matches no code)
+    otherwise. Join keys use this to keep the big encoded side on its i32
+    codes — the small plain side pays one searchsorted instead of the big
+    side paying a per-row decode."""
+    dev = _codebook_device(book)
+    vals = c.canon().data
+    ct = jnp.promote_types(dev.dtype, vals.dtype)
+    bw = dev.astype(ct)
+    vw = vals.astype(ct)
+    idx = jnp.clip(jnp.searchsorted(bw, vw), 0,
+                   dev.shape[0] - 1).astype(jnp.int32)
+    return jnp.where(bw[idx] == vw, idx, jnp.full((), -1, jnp.int32))
+
+
 @dataclass
 class PackedTable:
     """A columnar table packed for ONE-transfer upload through a tunneled
@@ -338,24 +557,32 @@ class PackedTable:
     replay a stale program. Requires x64 (i64/f64 lanes)."""
     names: list[str]
     dtypes: list[str]           # logical dtypes
-    lanes: tuple                # per-column lane tags, see _LANE_WIRE
+    lanes: tuple                # per-column WIRE lane tags (code lane for
+    #                             dict-encoded columns), see _LANE_WIRE
     cap: int                    # padded row capacity
-    data: jax.Array             # uint8[lane_bytes(lanes, cap)]
+    data: jax.Array             # uint8[enc_lane_bytes(lanes, cap, encs)]
     dictionaries: tuple = ()    # host dictionaries for "str" columns
+    # per-column encoding tags ("plain" | ("dict", card) | ("rle", runs
+    # bound)); () = all plain (the pre-encoding layout, byte-identical)
+    encs: tuple = ()
+    codebooks: tuple = ()       # host sorted value arrays for dict columns
 
     @property
     def capacity(self) -> int:
         return self.cap
 
+    def col_enc(self, i: int):
+        return self.encs[i] if self.encs else "plain"
+
 
 def _packed_flatten(p: PackedTable):
     return (p.data,), (tuple(p.names), tuple(p.dtypes), p.lanes, p.cap,
-                       _ById(p.dictionaries))
+                       _ByIds(p.dictionaries), p.encs, _ByIds(p.codebooks))
 
 
 def _packed_unflatten(aux, children):
     return PackedTable(list(aux[0]), list(aux[1]), aux[2], aux[3],
-                       children[0], aux[4].obj)
+                       children[0], aux[4].obj, aux[5], aux[6].obj)
 
 
 jax.tree_util.register_pytree_node(PackedTable, _packed_flatten,
@@ -363,7 +590,8 @@ jax.tree_util.register_pytree_node(PackedTable, _packed_flatten,
 
 
 def pack_table(table: Table, capacity: Optional[int] = None,
-               lanes: Optional[tuple] = None) -> Optional[PackedTable]:
+               lanes: Optional[tuple] = None, encs: Optional[tuple] = None,
+               codebooks: Optional[tuple] = None) -> Optional[PackedTable]:
     """Host-side packing for upload; None if the table can't pack under the
     given lane spec (default: the legacy wide layout, which rejects
     strings/bools exactly like the pre-lane int64 carrier did).
@@ -388,18 +616,64 @@ def pack_table(table: Table, capacity: Optional[int] = None,
     from ...obs.trace import TRACER
     with TRACER.span("lane.pack", cat="upload", rows=n,
                      cols=len(table.columns), capacity=cap):
-        return _pack_table(table, lanes, n, cap)
+        return _pack_table(table, lanes, n, cap, encs, codebooks)
 
 
-def _pack_table(table: Table, lanes: tuple, n: int,
-                cap: int) -> PackedTable:
-    payload, dicts = _pack_payload(table, lanes, n, cap)
+def _pack_table(table: Table, lanes: tuple, n: int, cap: int,
+                encs: Optional[tuple] = None,
+                codebooks: Optional[tuple] = None) -> PackedTable:
+    payload, dicts = _pack_payload(table, lanes, n, cap, encs, codebooks)
     return PackedTable(list(table.names), [c.dtype for c in table.columns],
-                       tuple(lanes), cap, jnp.asarray(payload), tuple(dicts))
+                       tuple(lanes), cap, jnp.asarray(payload), tuple(dicts),
+                       tuple(encs) if encs else (),
+                       tuple(codebooks) if codebooks else ())
 
 
-def _pack_payload(table: Table, lanes: tuple, n: int,
-                  cap: int) -> tuple[np.ndarray, list]:
+def _pack_col_rle(name: str, buf: np.ndarray, lane: str, runs_bound: int,
+                  cap: int) -> list[np.ndarray]:
+    """(values, run-lengths) sections for one canonicalized cap-padded
+    column buffer. Run lengths sum to cap exactly (the capacity pad rides
+    the trailing run), so device expansion reconstructs the buffer
+    bit-for-bit; more runs than the planned capacity is stats drift."""
+    rc = _runs_cap(runs_bound, cap)
+    if cap == 0:
+        return [np.zeros(0, dtype=_LANE_NP[lane]).view(np.uint8),
+                np.zeros(0, dtype=np.int32).view(np.uint8)]
+    starts = np.concatenate(
+        [[0], np.flatnonzero(buf[1:] != buf[:-1]) + 1])
+    if len(starts) > rc:
+        raise EncodingOverflowError(
+            f"column {name!r}: {len(starts)} runs overflow the planned "
+            f"run capacity {rc} (runs bound {runs_bound})")
+    lengths = np.diff(np.concatenate([starts, [cap]]))
+    vbuf = np.zeros(rc, dtype=_LANE_NP[lane])
+    lbuf = np.zeros(rc, dtype=np.int32)
+    vbuf[:len(starts)] = buf[starts]
+    lbuf[:len(starts)] = lengths
+    return [vbuf.view(np.uint8), lbuf.view(np.uint8)]
+
+
+def _dict_codes(name: str, data: np.ndarray, v: np.ndarray, n: int,
+                book: np.ndarray) -> np.ndarray:
+    """Row codes into a sorted codebook; a VALID value missing from the
+    book is stats drift (null/dead slots ride code 0 like plain zeros)."""
+    idx = np.searchsorted(book, data)
+    safe = np.clip(idx, 0, max(len(book) - 1, 0))
+    ok = (idx < len(book)) & (book[safe] == data) if len(book) else \
+        np.zeros(len(data), dtype=bool)
+    bad = ~ok & v
+    if n and bad[:n].any():
+        missing = data[:n][bad[:n]][0]
+        raise EncodingOverflowError(
+            f"column {name!r}: value {int(missing)} not in the planned "
+            f"dictionary (card {len(book)})")
+    return np.where(v, safe, 0).astype(np.int64)
+
+
+def _pack_payload(table: Table, lanes: tuple, n: int, cap: int,
+                  encs: Optional[tuple] = None,
+                  codebooks: Optional[tuple] = None) -> tuple[np.ndarray,
+                                                              list]:
     """Host-side packed payload bytes (the PackedTable wire format) WITHOUT
     the device upload: sharded morsel staging packs one payload per replica
     row block and uploads the concatenation in a single row-sharded
@@ -408,7 +682,9 @@ def _pack_payload(table: Table, lanes: tuple, n: int,
     vparts: list[np.ndarray] = []
     dicts = []
     for ci, (c, lane) in enumerate(zip(table.columns, lanes)):
-        if not lane_legal(lane, c.dtype):
+        enc = encs[ci] if encs else "plain"
+        dict_enc = isinstance(enc, tuple) and enc[0] == "dict"
+        if not dict_enc and not lane_legal(lane, c.dtype):
             raise LaneOverflowError(
                 f"column {table.names[ci]!r}: lane {lane!r} illegal for "
                 f"dtype {c.dtype!r}")
@@ -421,6 +697,9 @@ def _pack_payload(table: Table, lanes: tuple, n: int,
         else:
             dicts.append(None)
             data = np.where(v, data, np.zeros((), dtype=data.dtype))
+        if dict_enc:
+            # data section holds codebook codes on the (narrower) code lane
+            data = _dict_codes(table.names[ci], data, v, n, codebooks[ci])
         if lane == "b1":
             bits = np.zeros(cap, dtype=bool)
             bits[:n] = data.astype(bool)
@@ -435,7 +714,11 @@ def _pack_payload(table: Table, lanes: tuple, n: int,
                         f"[{dmin}, {dmax}] overflow lane {lane!r}")
             buf = np.zeros(cap, dtype=_LANE_NP[lane])
             buf[:n] = data
-            parts.append(buf.view(np.uint8))
+            if isinstance(enc, tuple) and enc[0] == "rle":
+                parts.extend(_pack_col_rle(table.names[ci], buf, lane,
+                                           enc[1], cap))
+            else:
+                parts.append(buf.view(np.uint8))
         vbits = np.zeros(cap, dtype=bool)
         vbits[:n] = v
         vparts.append(np.packbits(vbits, bitorder="little"))
@@ -475,17 +758,39 @@ def unpack_table(p: PackedTable) -> DTable:
     """Traced (or concrete) unpacking back into per-column device arrays:
     each column is a zero-copy byte-slice view of the single uploaded
     buffer, bitcast to its lane carrier and widened to its signed device
-    dtype — all of which fuses into the consuming compiled program."""
+    dtype — all of which fuses into the consuming compiled program.
+    Dict-encoded columns come up as i32 codes with the host codebook
+    attached (execution stays on codes; decode_col materializes values
+    per-site); RLE columns expand to row-aligned values right here (a
+    static-shape jnp.repeat that fuses like the bitcasts do)."""
+    from jax import lax
+
     vbytes = (p.cap + 7) // 8
     cols = []
     off = 0
-    voff = sum(_lane_rows_bytes(ln, p.cap) for ln in p.lanes)
+    encs = p.encs or ("plain",) * len(p.dtypes)
+    voff = sum(enc_rows_bytes(ln, e, p.cap)
+               for ln, e in zip(p.lanes, encs))
     dicts = p.dictionaries or (None,) * len(p.dtypes)
-    for dtype, lane, dc in zip(p.dtypes, p.lanes, dicts):
-        sz = _lane_rows_bytes(lane, p.cap)
-        d = _unpack_lane(p.data[off:off + sz], lane, p.cap)
+    books = p.codebooks or (None,) * len(p.dtypes)
+    for dtype, lane, dc, enc, book in zip(p.dtypes, p.lanes, dicts, encs,
+                                          books):
+        sz = enc_rows_bytes(lane, enc, p.cap)
+        seg = p.data[off:off + sz]
+        if isinstance(enc, tuple) and enc[0] == "rle":
+            rc = _runs_cap(enc[1], p.cap)
+            vsz = _LANE_WIRE[lane] * rc
+            vals = _unpack_lane(seg[:vsz], lane, rc)
+            lens = lax.bitcast_convert_type(
+                seg[vsz:vsz + 4 * rc].reshape(rc, 4), jnp.int32)
+            d = jnp.repeat(vals, lens, total_repeat_length=p.cap)
+            book = None
+        else:
+            d = _unpack_lane(seg, lane, p.cap)
+            if not (isinstance(enc, tuple) and enc[0] == "dict"):
+                book = None
         valid = _unpack_bits(p.data[voff:voff + vbytes], p.cap)
-        cols.append(DCol(dtype, d, valid, dc))
+        cols.append(DCol(dtype, d, valid, dc, codebook=book))
         off += sz
         voff += vbytes
     alive = _unpack_bits(p.data[voff:voff + vbytes], p.cap)
@@ -493,11 +798,13 @@ def unpack_table(p: PackedTable) -> DTable:
 
 
 def widen_col(c: DCol) -> DCol:
-    """Physical-width view of a column: a narrow-lane device array
-    (encoded execution) widens to the logical physical dtype. Callers are
-    the sites that genuinely need 64-bit arithmetic — aggregate/window
-    arguments and decimal rescaling — everything else (filters, join keys,
-    group keys, sorts) runs on the narrow encoding."""
+    """Physical-width view of a column: an encoded column decodes
+    (decode_col) and a narrow-lane device array widens to the logical
+    physical dtype. Callers are the sites that genuinely need 64-bit
+    arithmetic — aggregate/window arguments and decimal rescaling —
+    everything else (filters, join keys, group keys, sorts) runs on the
+    narrow encoding."""
+    c = decode_col(c)
     if c.dtype in ("bool", "str", "date", "float"):
         return c
     pd = phys_dtype(c.dtype)
@@ -553,6 +860,11 @@ def to_host(dt: DTable, count: Optional[int] = None) -> Table:
         c = _flatten_compound(c)
         data = np.asarray(c.data)[idx]
         valid = np.asarray(c.valid)[idx]
+        if c.codebook is not None:
+            # output materialization IS a decode site: codes -> values
+            book = c.codebook
+            safe = np.clip(data, 0, max(len(book) - 1, 0))
+            data = np.where(valid, book[safe] if len(book) else 0, 0)
         if c.dtype == "str":
             data = np.where(valid, data, _NULL_CODE).astype(np.int32)
         host_dtype = phys_np(c.dtype)

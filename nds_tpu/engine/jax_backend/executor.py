@@ -44,9 +44,10 @@ from ..plan import (
 )
 from . import jexprs, kernels
 from . import pallas_kernels as _pallas
-from .device import (DCol, DTable, PackedTable, bucket, free_dtable,
-                     phys_dtype, rank_key, string_rank_lut, to_device,
-                     to_host, unpack_table, widen_col)
+from .device import (DCol, DTable, PackedTable, bucket, decode_col,
+                     encode_against, free_dtable, phys_dtype, rank_key,
+                     string_rank_lut, to_device, to_host, unpack_table,
+                     widen_col)
 
 _I32 = jnp.int32
 
@@ -1318,7 +1319,7 @@ class JaxExecutor:
         """
         if self._replay:
             dt = self.execute(plan)
-            col = dt.cols[0]
+            col = decode_col(dt.cols[0])
             if col.dtype == "str" or col.parts is not None:
                 raise NotJittable("string scalar subquery under trace")
             perm, cnt = kernels.compaction_perm(dt.alive)
@@ -1730,9 +1731,10 @@ class JaxExecutor:
             for i, gc in enumerate(group_cols):
                 if i < k:
                     cd = gc.canon().data
-                    out_cols.append(DCol(gc.dtype, cd[orig],
-                                         gc.valid[orig] & alive_out,
-                                         gc.dictionary))
+                    # sort/scan ran on codes; decode the group-sized output
+                    out_cols.append(decode_col(DCol(
+                        gc.dtype, cd[orig], gc.valid[orig] & alive_out,
+                        gc.dictionary, codebook=gc.codebook)))
                 else:
                     out_cols.append(DCol(
                         gc.dtype, jnp.zeros(cap_out, phys_dtype(gc.dtype)),
@@ -2010,8 +2012,9 @@ class JaxExecutor:
         ai = 0
         for i, gc in enumerate(group_cols):
             if i in keep_set:
-                out_cols.append(DCol(gc.dtype, out_codes[ai], out_cvals[ai],
-                                     gc.dictionary))
+                out_cols.append(decode_col(DCol(
+                    gc.dtype, out_codes[ai], out_cvals[ai], gc.dictionary,
+                    codebook=gc.codebook)))
                 ai += 1
             else:
                 out_cols.append(DCol(gc.dtype,
@@ -2059,7 +2062,12 @@ class JaxExecutor:
             if i in keep_set:
                 vals, valid = kernels.group_representatives(
                     gid, alive_for_agg, gc.canon().data, gc.valid, cap_out)
-                out_cols.append(DCol(gc.dtype, vals, valid, gc.dictionary))
+                # grouping ran on codes (rank_key); the group-output
+                # representative is the decode site — group-sized, not
+                # row-sized
+                out_cols.append(decode_col(DCol(gc.dtype, vals, valid,
+                                                gc.dictionary,
+                                                codebook=gc.codebook)))
             else:  # rolled-up column: NULL
                 out_cols.append(DCol(gc.dtype,
                                      jnp.zeros(cap_out, phys_dtype(gc.dtype)),
@@ -2356,8 +2364,9 @@ class JaxExecutor:
         def rebuild(cols_src, flat):
             out = []
             for i, c in enumerate(cols_src):
-                out.append(DCol(c.dtype, flat[2 * i],
-                                flat[2 * i + 1].astype(bool), c.dictionary))
+                out.append(dataclasses.replace(
+                    c, data=flat[2 * i],
+                    valid=flat[2 * i + 1].astype(bool), parts=None))
             return out
         cols = rebuild(left.cols, list(out_l)) + rebuild(right.cols,
                                                          list(out_r))
@@ -2461,9 +2470,10 @@ class JaxExecutor:
         # (canonical zeros under ~matched: DCol's null-payload invariant)
         def null_out(c: DCol) -> DCol:
             data = jnp.where(matched, c.data, jnp.zeros((), c.data.dtype))
-            return DCol(c.dtype, data, c.valid & matched, c.dictionary,
-                        None if c.parts is None else tuple(
-                            null_out(p) for p in c.parts))
+            return dataclasses.replace(
+                c, data=data, valid=c.valid & matched,
+                parts=None if c.parts is None else tuple(
+                    null_out(p) for p in c.parts))
         out_cols = list(left.cols) + [null_out(c) for c in rcols]
         return DTable(list(node.out_names), out_cols, left.alive)
 
@@ -2548,9 +2558,11 @@ def _shift_residual(expr: BExpr, nl: int, nr: int) -> BExpr:
 def _gather_col(c: DCol, idx: jax.Array) -> DCol:
     parts = None
     if c.parts is not None:
-        parts = tuple(DCol(p.dtype, p.data[idx], p.valid[idx], p.dictionary)
+        parts = tuple(dataclasses.replace(p, data=p.data[idx],
+                                          valid=p.valid[idx])
                       for p in c.parts)
-    return DCol(c.dtype, c.data[idx], c.valid[idx], c.dictionary, parts)
+    return dataclasses.replace(c, data=c.data[idx], valid=c.valid[idx],
+                               parts=parts)
 
 
 def _gather_cols(cols: list, idx: jax.Array) -> list:
@@ -2579,17 +2591,36 @@ def _gather_cols(cols: list, idx: jax.Array) -> list:
         if c.parts is not None:
             ps = []
             for p in c.parts:
-                ps.append(DCol(p.dtype, flat[i], flat[i + 1], p.dictionary))
+                ps.append(dataclasses.replace(p, data=flat[i],
+                                              valid=flat[i + 1]))
                 i += 2
             parts = tuple(ps)
-        out.append(DCol(c.dtype, data, valid, c.dictionary, parts))
+        out.append(dataclasses.replace(c, data=data, valid=valid,
+                                       parts=parts))
     return out
 
 
 def _joinable_pair(a: DCol, b: DCol) -> tuple[jax.Array, jax.Array]:
-    """Comparable device key arrays for a join key pair."""
+    """Comparable device key arrays for a join key pair.
+
+    Encoded execution: when one side carries a dictionary codebook the
+    join runs ON CODES — the plain side's values remap into the encoded
+    side's code space (device.encode_against: exact code or -1, which
+    matches nothing), so the big encoded side keeps its i32 codes through
+    dense-rank/build/probe instead of decoding every row. Codes are only
+    ever compared against codes of the SAME codebook; equality of codes is
+    equality of values by construction, and validity masks carry the null
+    semantics exactly as on the plain path."""
     if a.dtype == "str" or b.dtype == "str":
         return jexprs._string_pair_keys(a, b)
+    if a.codebook is not None or b.codebook is not None:
+        if a.codebook is b.codebook:
+            return a.canon().data, b.canon().data
+        if a.codebook is not None and b.codebook is None:
+            return a.canon().data, encode_against(a.codebook, b)
+        if b.codebook is not None and a.codebook is None:
+            return encode_against(b.codebook, a), b.canon().data
+        a, b = decode_col(a), decode_col(b)   # distinct codebooks
     da, db = a.canon().data, b.canon().data
     if da.dtype != db.dtype:
         ct = jnp.promote_types(da.dtype, db.dtype)
@@ -2600,24 +2631,22 @@ def _joinable_pair(a: DCol, b: DCol) -> tuple[jax.Array, jax.Array]:
 def _null_extend(left: DTable, right: DTable, left_mask: jax.Array,
                  side: str, names: list[str]) -> DTable:
     """Left rows selected by mask, with the right side all-NULL (outer join)."""
-    cols = [DCol(c.dtype, c.data, c.valid, c.dictionary, c.parts)
-            for c in left.cols]
+    cols = [dataclasses.replace(c) for c in left.cols]
     for c in right.cols:
-        cols.append(DCol(c.dtype,
-                         jnp.zeros(left.capacity, c.data.dtype),
-                         jnp.zeros(left.capacity, bool), c.dictionary))
+        cols.append(dataclasses.replace(
+            c, data=jnp.zeros(left.capacity, c.data.dtype),
+            valid=jnp.zeros(left.capacity, bool), parts=None))
     return DTable(names, cols, left_mask)
 
 
 def _null_extend_left(left: DTable, right: DTable, right_mask: jax.Array,
                       names: list[str]) -> DTable:
     """Right rows selected by mask, with the left side all-NULL (full outer)."""
-    cols = [DCol(c.dtype,
-                 jnp.zeros(right.capacity, c.data.dtype),
-                 jnp.zeros(right.capacity, bool), c.dictionary)
-            for c in left.cols]
-    cols += [DCol(c.dtype, c.data, c.valid, c.dictionary, c.parts)
-             for c in right.cols]
+    cols = [dataclasses.replace(
+        c, data=jnp.zeros(right.capacity, c.data.dtype),
+        valid=jnp.zeros(right.capacity, bool), parts=None)
+        for c in left.cols]
+    cols += [dataclasses.replace(c) for c in right.cols]
     return DTable(names, cols, right_mask)
 
 
@@ -2644,6 +2673,10 @@ def _concat_dtables(pieces: list[DTable], names: list[str]) -> DTable:
 
 
 def _flatten_for_concat(c: DCol) -> DCol:
+    # pieces may mix encodings (an encoded inner-join piece concatenated
+    # with a plain null-extension): codes from different codebooks must
+    # never share a buffer, so concatenation is a decode site
+    c = decode_col(c)
     if c.parts is None:
         return c
     from .device import _flatten_compound

@@ -20,7 +20,8 @@ import numpy as np
 
 from ..column import dec_scale, is_dec
 from ..plan import BCall, BCol, BExpr, BLit, BParam, BScalarSubquery
-from .device import DCol, DTable, phys_dtype, string_rank_lut, widen_col
+from .device import (DCol, DTable, decode_col, phys_dtype, string_rank_lut,
+                     widen_col)
 
 SubqueryEval = Callable[[object], object]
 
@@ -95,7 +96,10 @@ def constant(dtype: str, value, n: int, valid=None) -> DCol:
 
 
 def _args(expr: BCall, table: DTable, sq) -> list[DCol]:
-    return [evaluate(a, table, sq) for a in expr.args]
+    """Evaluated arguments with encoded columns DECODED: every generic
+    handler computes on values. Encoding-aware handlers (_compare/_in_list
+    literal remaps) evaluate raw instead and stay on codes."""
+    return [decode_col(evaluate(a, table, sq)) for a in expr.args]
 
 
 def _both(a: DCol, b: DCol) -> jax.Array:
@@ -224,9 +228,69 @@ _CMP = {"eq": jnp.equal, "ne": jnp.not_equal, "lt": jnp.less,
         "le": jnp.less_equal, "gt": jnp.greater, "ge": jnp.greater_equal}
 
 
+_FLIP = {"eq": "eq", "ne": "ne", "lt": "gt", "le": "ge", "gt": "lt",
+         "ge": "le"}
+
+
+def _code_space_compare(op: str, c: DCol, value) -> Optional[jax.Array]:
+    """col <op> literal ON CODES: the sorted codebook is order-isomorphic
+    to the values, so the literal remaps to a code-space threshold at
+    trace time (exact — a value between dictionary entries lands on the
+    searchsorted boundary, one absent from an eq/ne on the right constant
+    answer). Returns the raw compare output (validity handled by caller),
+    or None when the op cannot remap."""
+    # compare in int64: a literal outside the (i32) codebook dtype's range
+    # must land on the correct boundary, not overflow
+    book = np.asarray(c.codebook, dtype=np.int64)
+    value = np.int64(max(min(int(value), np.iinfo(np.int64).max),
+                         np.iinfo(np.int64).min))
+    i = int(np.searchsorted(book, value, side="left"))
+    present = i < len(book) and book[i] == value
+    codes = c.data
+    if op == "eq":
+        return (codes == i) if present else jnp.zeros(codes.shape, bool)
+    if op == "ne":
+        return (codes != i) if present else jnp.ones(codes.shape, bool)
+    if op == "lt":
+        return codes < i
+    if op == "ge":
+        return codes >= i
+    hi = int(np.searchsorted(book, value, side="right"))
+    if op == "le":
+        return codes < hi
+    if op == "gt":
+        return codes >= hi
+    return None
+
+
+def _lit_value(e, dtype: str):
+    """The engine-unit literal of a BLit comparable against `dtype`, or
+    None when the expression is not a safely-remappable literal."""
+    if not isinstance(e, BLit) or e.value is None:
+        return None
+    if e.dtype != dtype or dtype not in ("int", "date") and not is_dec(dtype):
+        return None
+    return int(e.value)
+
+
 def _compare(op: str):
     def run(expr: BCall, table: DTable, sq) -> DCol:
-        a, b = _args(expr, table, sq)
+        a, b = [evaluate(x, table, sq) for x in expr.args]
+        # encoded execution: column-vs-literal compares remap the literal
+        # into code space at trace time instead of decoding every row
+        out = None
+        if a.codebook is not None and b.codebook is None:
+            v = _lit_value(expr.args[1], a.dtype)
+            if v is not None:
+                out = _code_space_compare(op, a, v)
+        elif b.codebook is not None and a.codebook is None:
+            v = _lit_value(expr.args[0], b.dtype)
+            if v is not None:
+                out = _code_space_compare(_FLIP[op], b, v)
+        if out is not None:
+            valid = _both(a, b)
+            return DCol("bool", out & valid, valid)
+        a, b = decode_col(a), decode_col(b)
         valid = _both(a, b)
         if a.dtype == "str" or b.dtype == "str":
             ka, kb = _string_pair_keys(a, b)
@@ -290,11 +354,32 @@ def _in_list(expr: BCall, table: DTable, sq) -> DCol:
         values = [param(v, 1).data[0] if isinstance(v, BParam) else v
                   for v in values]
     has_null = any(v is None for v in values)
+    traced = any(isinstance(v, jax.Array) or
+                 isinstance(v, jax.core.Tracer) for v in values)
+    if a.codebook is not None and traced:
+        a = decode_col(a)    # traced params cannot remap at trace time
     if a.dtype == "str":
         d = _dict(a)
         vset = {v for v in values if v is not None}
         hit = np.asarray([v in vset for v in d], dtype=bool)
         out = _lut_gather(a.data, hit) if len(d) else jnp.zeros(len(a), bool)
+    elif a.codebook is not None:
+        # membership ON CODES: list items remap through the sorted codebook
+        # at trace time; absent values simply contribute no code
+        if is_dec(a.dtype):
+            from ..exprs import _scaled_in_values
+            vals = _scaled_in_values(values, dec_scale(a.dtype))
+        else:
+            vals = [int(v) for v in values if v is not None]
+        book = a.codebook.astype(np.int64)
+        varr = np.asarray(vals, dtype=np.int64) if vals \
+            else np.zeros(0, dtype=np.int64)
+        idx = np.searchsorted(book, varr)
+        safe = np.clip(idx, 0, max(len(book) - 1, 0))
+        codes = safe[(idx < len(book)) & (book[safe] == varr)] \
+            if len(book) else safe[:0]
+        out = jnp.isin(a.data, jnp.asarray(codes, jnp.int32)) \
+            if codes.size else jnp.zeros(a.data.shape, bool)
     elif is_dec(a.dtype):
         from ..exprs import _scaled_in_values
         vals = _scaled_in_values(values, dec_scale(a.dtype))
@@ -339,9 +424,9 @@ def _like(expr: BCall, table: DTable, sq) -> DCol:
 
 def _case(expr: BCall, table: DTable, sq) -> DCol:
     pairs = expr.args[:-1]
-    else_col = evaluate(expr.args[-1], table, sq)
+    else_col = decode_col(evaluate(expr.args[-1], table, sq))
     result_dtype = expr.dtype
-    branch_cols = [evaluate(pairs[i + 1], table, sq)
+    branch_cols = [decode_col(evaluate(pairs[i + 1], table, sq))
                    for i in range(0, len(pairs), 2)]
     branch_cols.append(else_col)
     dictionary = None
@@ -569,7 +654,7 @@ def _round(expr: BCall, table: DTable, sq) -> DCol:
 
 
 def _grouping_bit(expr: BCall, table: DTable, sq) -> DCol:
-    a = evaluate(expr.args[0], table, sq)
+    a = decode_col(evaluate(expr.args[0], table, sq))
     bit = int(expr.extra)
     out = (a.data.astype(phys_dtype("int")) >> bit) & 1
     return DCol("int", out, a.valid)

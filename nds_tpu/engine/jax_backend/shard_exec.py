@@ -55,13 +55,18 @@ from .executor import JaxExecutor, ReplayMismatch, _no_load, _Recorder
 # -- sharded morsel staging ---------------------------------------------------
 
 def stage_sharded(table: Table, mesh, shard_cap: int,
-                  lanes: Optional[tuple] = None):
+                  lanes: Optional[tuple] = None,
+                  encs: Optional[tuple] = None,
+                  codebooks: Optional[tuple] = None):
     """Pack + upload one morsel row-sharded over `mesh`: per-replica row
     blocks (streaming.partition_morsel_rows) each packed at `shard_cap`
     capacity, concatenated, and committed with ONE device_put under
     NamedSharding(P("shards")). Returns a PackedTable whose `cap` is the
     PER-REPLICA capacity — inside the shard_map body each replica sees its
-    own payload slice, so unpack_table yields that replica's rows. Falls
+    own payload slice, so unpack_table yields that replica's rows. Encoded
+    execution rides along unchanged: each replica block packs under the
+    SAME static encoding spec (dict codes / rle pairs), so block payloads
+    stay equal-length and the flat buffer still divides evenly. Falls
     back to a row-sharded plain DTable when the layout cannot pack."""
     n_shards = mesh.devices.size
     axis = mesh.axis_names[0]
@@ -81,13 +86,15 @@ def stage_sharded(table: Table, mesh, shard_cap: int,
             for lo, hi in spans:
                 payload, dicts = _pack_payload(table.slice(lo, hi),
                                                tuple(lanes), hi - lo,
-                                               shard_cap)
+                                               shard_cap, encs, codebooks)
                 payloads.append(payload)
             flat = np.concatenate(payloads)
             data = jax.device_put(flat, sharding)
             return PackedTable(list(table.names),
                                [c.dtype for c in table.columns],
-                               tuple(lanes), shard_cap, data, tuple(dicts))
+                               tuple(lanes), shard_cap, data, tuple(dicts),
+                               tuple(encs) if encs else (),
+                               tuple(codebooks) if codebooks else ())
         return _sharded_dtable(table, spans, shard_cap, sharding)
 
 

@@ -115,6 +115,12 @@ class ScanNode(PlanNode):
     # Width metadata so the plan verifier can prove every lane wide enough
     # for its column's value range BEFORE a morsel ships on it.
     lanes: Optional[tuple] = None
+    # per-column wire encoding tags ("plain" | ("dict", card) |
+    # ("rle", runs_bound), device.plan_encodings) for packed morsel scans;
+    # None = all plain. Encoding metadata so the verifier can prove each
+    # spec legal against recorded cardinality/run stats (the "encoding"
+    # findings), and so program fingerprints include the physical encoding.
+    encodings: Optional[tuple] = None
 
 
 @dataclass

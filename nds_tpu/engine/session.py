@@ -41,6 +41,28 @@ def _engine_table_stats(t: Table) -> dict:
     return out
 
 
+def _enc_tag(enc) -> str:
+    """Human/JSON-stable encoding tag for stats/bench reporting."""
+    if isinstance(enc, tuple):
+        return f"{enc[0]}[{enc[1]}]"
+    return str(enc)
+
+
+def _engine_col_enc_stat(t: Table, col: str):
+    """Encoding stats (cardinality/runs) for one column of an engine
+    Table (view registrations): engine units by construction."""
+    from .column import is_dec
+
+    i = t.names.index(col)
+    c = t.columns[i]
+    if not (c.dtype in ("int", "date") or is_dec(c.dtype)):
+        return None
+    import numpy as np
+
+    return arrow_bridge.column_enc_stat_values(
+        np.asarray(c.data), c.validity)
+
+
 def _and_conjuncts(node):
     """Top-level AND conjuncts of a WHERE AST (shared by the partition and
     file-stats delete pruners)."""
@@ -77,6 +99,12 @@ class Session:
         # evaluated and cached (column_stats); registration/drop invalidates
         self._stats_sources: dict = {}
         self._col_stats: dict[str, dict] = {}
+        # per-table per-column ENCODING stats (cardinality + run counts)
+        # for encoded-execution planning: name -> callable(column) ->
+        # {"distinct": ..., "runs": ...} or None, lazily evaluated and
+        # cached per column (column_enc_stats); registration invalidates
+        self._enc_stats_sources: dict = {}
+        self._enc_stats: dict[str, dict] = {}
         # device-backend fallback observability, reset per sql() call
         self.last_fallbacks: list[str] = []
         # execution-mode/timing observability for the last sql() call:
@@ -208,6 +236,9 @@ class Session:
         self._batch_sources[name] = batches
         self._stats_sources[name] = \
             lambda t=table, dec=dec: arrow_bridge.table_column_stats(t, dec)
+        self._enc_stats_sources[name] = \
+            lambda col, t=table, dec=dec: \
+            arrow_bridge.column_enc_stat(t.column(col), dec)
         self._drop_cached(name)
         self._generation += 1
 
@@ -217,6 +248,13 @@ class Session:
         """Register a parquet file or partitioned directory as a table."""
         dataset = pa_dataset.dataset(path, format="parquet",
                                      partitioning="hive")
+        # re-open with dictionary pass-through for the fully dictionary-
+        # encoded string columns (metadata probe): the staging thread then
+        # receives codes + dictionary instead of re-encoding every morsel
+        fmt = arrow_bridge.parquet_dataset_format(list(dataset.files))
+        if fmt is not None:
+            dataset = pa_dataset.dataset(path, format=fmt,
+                                         partitioning="hive")
         schema = dataset.schema
         dec = self._dec_as_int()
         names, dtypes = arrow_bridge.engine_schema(schema, dec)
@@ -240,6 +278,12 @@ class Session:
         self._stats_sources[name] = \
             lambda ds=dataset, dec=dec: arrow_bridge.parquet_column_stats(
                 list(ds.files), dec)
+        # encoding stats need the values (cardinality/runs have no parquet
+        # metadata): ONE vectorized single-column read, cached per column
+        # per registration generation
+        self._enc_stats_sources[name] = \
+            lambda col, ds=dataset, dec=dec: arrow_bridge.column_enc_stat(
+                ds.to_table(columns=[col]).column(col), dec)
         self._drop_cached(name)
         self._generation += 1
 
@@ -301,6 +345,8 @@ class Session:
         self._loaders[name] = lambda columns=None, t=table: \
             t if columns is None else t.select(list(columns))
         self._stats_sources[name] = lambda t=table: _engine_table_stats(t)
+        self._enc_stats_sources[name] = \
+            lambda col, t=table: _engine_col_enc_stat(t, col)
         self._drop_cached(name)
         self._cache[(name, None)] = table
         self._generation += 1
@@ -310,6 +356,7 @@ class Session:
         self._loaders.pop(name, None)
         self._batch_sources.pop(name, None)
         self._stats_sources.pop(name, None)
+        self._enc_stats_sources.pop(name, None)
         self._drop_cached(name)
         self._est_rows.pop(name, None)
         self._unique_cols.pop(name, None)
@@ -322,6 +369,7 @@ class Session:
         for k in [k for k in self._cache if k[0] == name]:
             del self._cache[k]
         self._col_stats.pop(name, None)
+        self._enc_stats.pop(name, None)
 
     def column_stats(self, name: str) -> dict:
         """{column: (lo, hi)} value-range stats in ENGINE units (scaled
@@ -341,6 +389,51 @@ class Session:
                 stats = {}      # stats are an optimization, never a failure
         self._col_stats[name] = stats
         return stats
+
+    def column_enc_stats(self, name: str, columns=None) -> dict:
+        """{column: {"distinct": sorted int64 array or None, "runs": int}}
+        encoding stats for (a subset of) a registered table's columns, in
+        ENGINE units; {} when the registration has no encoding-stats
+        source. Lazily computed and cached PER COLUMN per registration
+        generation — only the columns a scan group actually streams pay
+        the (one-time) cardinality/run pass. Feeds device.plan_encodings
+        and the verifier's "encoding" findings."""
+        src = self._enc_stats_sources.get(name)
+        if src is None:
+            return {}
+        if columns is None:
+            columns = self._schemas.get(name, ([], []))[0]
+        cache = self._enc_stats.setdefault(name, {})
+        for c in columns:
+            if c in cache:
+                continue
+            try:
+                cache[c] = src(c)
+            except Exception:
+                cache[c] = None    # stats are an optimization, never fatal
+        return {c: cache[c] for c in columns if cache.get(c)}
+
+    @staticmethod
+    def _manifest_enc_source(wt, files, dataset, dec):
+        """Per-column encoding-stats source for a warehouse registration:
+        manifest-recorded per-file stats aggregate with no data read;
+        columns the manifest predates fall back to one vectorized
+        single-column dataset read."""
+        agg: dict = {}
+
+        def src(col):
+            if "done" not in agg:
+                try:
+                    agg["stats"] = wt.column_enc_stats(list(files))
+                except Exception:
+                    agg["stats"] = {}
+                agg["done"] = True
+            st = agg["stats"].get(col)
+            if st is not None:
+                return st
+            return arrow_bridge.column_enc_stat(
+                dataset.to_table(columns=[col]).column(col), dec)
+        return src
 
     def iter_morsels(self, name: str, columns: list[str], rows: int):
         """Yield host Tables of at most `rows` rows each, WITHOUT
@@ -508,7 +601,7 @@ class Session:
                 cfg.stream_compact_rows, cfg.shared_scan,
                 cfg.stream_fusion_max_branches, cfg.late_materialization,
                 cfg.late_mat_min_rows, cfg.decimal_physical, cfg.use_jax,
-                cfg.narrow_lanes, tuple(cfg.mesh_shape),
+                cfg.narrow_lanes, cfg.encoded_exec, tuple(cfg.mesh_shape),
                 int(cfg.mesh_shards or 0),
                 tuple(sorted(cfg.pallas_ops)))
 
@@ -554,15 +647,28 @@ class Session:
                 # pass (a per-morsel choice would be a width change =
                 # recompile mid-stream), recorded on the morsel ScanNodes
                 # so the verifier can prove them against the same stats
-                from .jax_backend.device import plan_lanes
+                from .jax_backend.device import (bucket, plan_encodings,
+                                                 plan_lanes)
                 for g in groups:
                     st = self.column_stats(g.table)
                     streaming.set_group_lanes(g, plan_lanes(
                         g.dtypes, [st.get(c) for c in g.columns]))
+                    if not self.config.encoded_exec or g.lanes is None:
+                        continue
+                    # generalize lanes from width to ENCODING: dictionary
+                    # codes / run-length pairs chosen once per group from
+                    # cardinality/run stats, static like the lanes are
+                    est = self.column_enc_stats(g.table, g.columns)
+                    planned = plan_encodings(
+                        g.dtypes, g.lanes, [est.get(c) for c in g.columns],
+                        bucket(self.config.chunk_rows))
+                    if planned is not None:
+                        streaming.set_group_encodings(g, *planned)
             if self.config.verify_plans == "per-pass":
                 # fused shared-scan partial plans are plan-IR rewrites that
                 # never pass through planner.PassPipeline — verify them here
-                streaming.verify_groups(groups, col_stats=self.column_stats)
+                streaming.verify_groups(groups, col_stats=self.column_stats,
+                                        enc_stats=self.column_enc_stats)
             # ONE executor serves every group of every job: groups run
             # sequentially, and sharing the scan cache uploads each
             # dimension table once instead of per branch
@@ -574,6 +680,8 @@ class Session:
             self._stream_cache[query] = sent
 
         plan, jobs, groups = sent["plan"], sent["jobs"], sent["groups"]
+        from .jax_backend.device import decode_stats
+        dec0 = decode_stats()
         mapping: dict = {}
         total_morsels = 0
         re_records = 0
@@ -582,6 +690,8 @@ class Session:
         sharded_groups = 0
         shard_stats: dict = {}   # collective_bytes / collective_ms across groups
         morsels_per_table: dict[str, int] = {}
+        host_decode_ms: dict[str, float] = {}
+        enc_bytes_saved = 0
         prefetch_errs: list[str] = []
         from .plan import MaterializedNode
         partials: list[list] = [[] for _ in jobs]
@@ -601,7 +711,7 @@ class Session:
             if out is None:
                 self._stream_cache[query] = None
                 return None     # not device-runnable: in-core path
-            morsels_run, rr, ub, sharded = out
+            morsels_run, rr, ub, sharded, host_ms = out
             total_morsels += morsels_run
             re_records += rr
             bytes_uploaded += ub
@@ -609,6 +719,15 @@ class Session:
             sharded_groups += 1 if sharded else 0
             morsels_per_table[group.table] = \
                 morsels_per_table.get(group.table, 0) + morsels_run
+            host_decode_ms[group.table] = round(
+                host_decode_ms.get(group.table, 0.0) + host_ms, 3)
+            if group.encodings is not None and group.plain_lanes is not None:
+                from .jax_backend.device import (bucket, enc_lane_bytes,
+                                                 lane_bytes)
+                cap = bucket(self.config.chunk_rows)
+                enc_bytes_saved += morsels_run * (
+                    lane_bytes(group.plain_lanes, cap) -
+                    enc_lane_bytes(group.lanes, cap, group.encodings))
         for ji, job in enumerate(jobs):
             if not partials[ji]:
                 self._stream_cache[query] = None
@@ -648,6 +767,7 @@ class Session:
         # streamed column rode (bytes_uploaded measures the win); EVERY
         # prefetch failure is recorded — they degrade to synchronous staging,
         # correct but slower, so the degradation must be observable
+        dec1 = decode_stats()
         self._finish_exec_stats(ExecStats.streaming(
             jobs=len(jobs),
             morsels=total_morsels,
@@ -663,6 +783,15 @@ class Session:
             narrow_lanes=bool(self.config.narrow_lanes),
             lane_spec={g.table: dict(zip(g.columns, g.lanes))
                        for g in groups if g.lanes is not None},
+            encoded_exec=bool(self.config.encoded_exec
+                              and self.config.narrow_lanes),
+            enc_spec={g.table: dict(zip(g.columns, [_enc_tag(e) for e in
+                                                    g.encodings]))
+                      for g in groups if g.encodings is not None} or None,
+            enc_bytes_saved=enc_bytes_saved or None,
+            decode_sites=dec1["sites"] - dec0["sites"],
+            decode_rows=dec1["rows"] - dec0["rows"],
+            host_decode_ms=host_decode_ms,
             mesh_shards=self._morsel_shards() if sharded_groups else None,
             sharded_groups=sharded_groups or None,
             collective_bytes=shard_stats.get("collective_bytes"),
@@ -746,8 +875,8 @@ class Session:
         shard_map, and one all_gather moves the bounded decomposed
         partials before the unchanged host merge
         (jax_backend/shard_exec.ShardedMorselQuery). Returns (morsels,
-        re_records, bytes_uploaded, sharded) or None when some member is
-        not device-runnable."""
+        re_records, bytes_uploaded, sharded, host_decode_ms) or None when
+        some member is not device-runnable."""
         import threading
 
         from . import streaming
@@ -856,18 +985,23 @@ class Session:
 
         def stage(morsel):
             """Pack + upload one union-column morsel into a fresh buffer
-            (group.lanes = the static narrow-lane spec; None = legacy wide
-            layout under --no_narrow_lanes). Sharded mode uploads the same
-            payload row-sharded over the replica mesh instead."""
+            (group.lanes = the static narrow-lane spec, group.encodings =
+            the static dict/rle encoding spec; None = legacy layouts under
+            --no_narrow_lanes / --no_encoded_exec). Sharded mode uploads
+            the same payload row-sharded over the replica mesh instead."""
             if mesh is not None:
                 from .jax_backend.shard_exec import stage_sharded
                 sub = morsel.select(group.columns)
                 return stage_sharded(sub, mesh, shard_cap,
-                                     lanes=group.lanes)
+                                     lanes=group.lanes,
+                                     encs=group.encodings,
+                                     codebooks=group.codebooks)
             with TRACER.span("morsel.stage", cat="upload",
                              table=group.table, rows=morsel.num_rows):
                 sub = morsel.select(group.columns)
-                packed = pack_table(sub, capacity=cap, lanes=group.lanes)
+                packed = pack_table(sub, capacity=cap, lanes=group.lanes,
+                                    encs=group.encodings,
+                                    codebooks=group.codebooks)
                 return packed if packed is not None else \
                     to_device(sub, capacity=cap)
 
@@ -895,9 +1029,24 @@ class Session:
 
         staged = {}
         stage_thread = None
+        host_ms = 0.0
+
+        def pull(it):
+            """Next morsel, with the host-side Arrow->engine decode wall
+            (IO + dictionary/validity materialization, arrow_bridge.
+            from_arrow inside iter_morsels) accounted per table — the
+            staging-thread bottleneck encoded execution is shrinking must
+            be measurable (ExecStats.host_decode_ms)."""
+            nonlocal host_ms
+            import time as _time
+            t0 = _time.perf_counter()
+            m = next(it, None)
+            host_ms += (_time.perf_counter() - t0) * 1000.0
+            return m
+
         try:
             it = iter(morsels)
-            morsel = next(it, None)
+            morsel = pull(it)
             while morsel is not None:
                 if state["cqs"] is None and not record_first(morsel):
                     return None
@@ -909,7 +1058,7 @@ class Session:
                         prefetch_errs.append(
                             f"{type(err).__name__}: {err}")
                     buf = stage(morsel)
-                nxt = next(it, None)
+                nxt = pull(it)
                 if nxt is not None:
                     # stage the NEXT morsel concurrently with this run
                     def work(m=nxt):
@@ -952,7 +1101,7 @@ class Session:
             current.pop("table", None)
         if count == 0:
             return None   # empty source: the in-core path handles it
-        return count, re_records, bytes_uploaded, mesh is not None
+        return count, re_records, bytes_uploaded, mesh is not None, host_ms
 
     def sql_arrow(self, query: str) -> pa.Table:
         return arrow_bridge.to_arrow(self.sql(query))

@@ -486,6 +486,14 @@ class ScanGroup:
     members: list[tuple]           # (job_index, branch_index)
     plans: list[PlanNode]
     lanes: Optional[tuple] = None
+    # encoded execution (device.plan_encodings): per-column wire encoding
+    # tags + host codebooks, chosen ONCE per group from cardinality/run
+    # stats like the lane spec is from range stats. When set, `lanes`
+    # already carries the dict columns' CODE lanes and `plain_lanes` keeps
+    # the value-lane spec for bytes-saved accounting / A-B comparison.
+    encodings: Optional[tuple] = None
+    codebooks: Optional[tuple] = None
+    plain_lanes: Optional[tuple] = None
 
     @property
     def morsel_key(self) -> str:
@@ -505,6 +513,25 @@ def set_group_lanes(group: ScanGroup, lanes: Optional[tuple]) -> None:
         scan = _morsel_scan(p)
         group.plans[i] = substitute_nodes(
             p, {id(scan): replace(scan, lanes=tuple(lanes))})
+
+
+def set_group_encodings(group: ScanGroup, encs: tuple, lanes: tuple,
+                        codebooks: tuple) -> None:
+    """Attach an encoding spec to a scan group (device.plan_encodings
+    output): recorded on the group (the packer's static per-morsel
+    contract) AND on every member plan's morsel ScanNode (encoding
+    metadata the verifier proves against the same cardinality/run stats,
+    and which program fingerprints include). `lanes` is the WIRE lane
+    spec — dict columns ride their code lane."""
+    group.plain_lanes = group.lanes
+    group.lanes = tuple(lanes)
+    group.encodings = tuple(encs)
+    group.codebooks = tuple(codebooks)
+    for i, p in enumerate(group.plans):
+        scan = _morsel_scan(p)
+        group.plans[i] = substitute_nodes(
+            p, {id(scan): replace(scan, lanes=tuple(lanes),
+                                  encodings=tuple(encs))})
 
 
 def _morsel_scan(plan: PlanNode) -> ScanNode:
@@ -577,7 +604,8 @@ def plan_scan_groups(jobs: list[StreamJob], shared: bool) -> list[ScanGroup]:
     return groups
 
 
-def verify_groups(groups: list[ScanGroup], col_stats=None) -> None:
+def verify_groups(groups: list[ScanGroup], col_stats=None,
+                  enc_stats=None) -> None:
     """Static verification of shared-scan fused partial plans: fuse_group
     rewrites every member's morsel scan into a union-column view, which is
     a plan-IR transform like any planner pass — a bad column mapping there
@@ -585,18 +613,23 @@ def verify_groups(groups: list[ScanGroup], col_stats=None) -> None:
     (callable table -> {column: (lo, hi)}), the group's upload lane spec is
     additionally proven wide enough for every column's recorded value range
     (a lane too narrow would otherwise only surface as a pack-time
-    LaneOverflowError mid-stream). Run by the session when
+    LaneOverflowError mid-stream); with `enc_stats` (callable
+    (table, columns) -> {column: {"distinct": ..., "runs": ...}}), every
+    dict/rle encoding is proven against the recorded cardinality/run stats
+    the same way (new "encoding" findings). Run by the session when
     EngineConfig.verify_plans == "per-pass" (the groups never flow through
     planner.PassPipeline); raises PlanVerifyError naming the group/member
     as the offending pass."""
     from ..obs.trace import TRACER
 
     with TRACER.span("stream.verify_groups", groups=len(groups)):
-        return _verify_groups(groups, col_stats)
+        return _verify_groups(groups, col_stats, enc_stats)
 
 
-def _verify_groups(groups: list[ScanGroup], col_stats=None) -> None:
-    from .verify import PlanVerifyError, check_scan_lanes, verify_plan
+def _verify_groups(groups: list[ScanGroup], col_stats=None,
+                   enc_stats=None) -> None:
+    from .verify import (PlanVerifyError, check_scan_encodings,
+                         check_scan_lanes, verify_plan)
 
     for gi, g in enumerate(groups):
         for mi, p in enumerate(g.plans):
@@ -612,6 +645,12 @@ def _verify_groups(groups: list[ScanGroup], col_stats=None) -> None:
             if findings:
                 raise PlanVerifyError(findings,
                                       f"narrow_lanes[group {gi}]")
+        if g.encodings is not None and enc_stats is not None:
+            findings = check_scan_encodings(
+                _morsel_scan(g.plans[0]), enc_stats(g.table, g.columns))
+            if findings:
+                raise PlanVerifyError(findings,
+                                      f"encoded_exec[group {gi}]")
 
 
 def _expr_subplans(node: PlanNode):

@@ -74,7 +74,8 @@ class Finding:
     node: object            # the offending PlanNode
     label: str              # stable preorder label, e.g. "ProjectNode#4"
     kind: str               # arity | colref | colname | dtype | agg | window
-    #                       | joinkey | setop | scan | lane | frozen | params
+    #                       | joinkey | setop | scan | lane | encoding
+    #                       | frozen | params
     message: str
 
     def __str__(self) -> str:
@@ -460,7 +461,11 @@ class _Verifier:
         """Width metadata legality: every declared upload lane must be able
         to carry its column's logical dtype at all, and (when the catalog
         records value-range stats) be wide enough for the column's actual
-        range — a too-narrow lane would truncate values on the wire."""
+        range — a too-narrow lane would truncate values on the wire.
+        Dict-encoded columns carry their CODE lane instead: value-range
+        legality does not apply (codes are bounded by cardinality, checked
+        by _chk_encodings), but the code lane must hold the declared
+        cardinality."""
         if n.lanes is None:
             return
         from .jax_backend.device import lane_legal
@@ -468,7 +473,13 @@ class _Verifier:
             self._add(n, "lane",
                       f"{len(n.lanes)} lanes vs {len(n.columns)} columns")
             return
-        for c, d, lane in zip(n.columns, n.out_dtypes, n.lanes):
+        self._chk_encodings(n)
+        encs = n.encodings or ("plain",) * len(n.columns)
+        if len(encs) != len(n.columns):
+            return                    # arity finding already added
+        for c, d, lane, enc in zip(n.columns, n.out_dtypes, n.lanes, encs):
+            if isinstance(enc, tuple) and enc[0] == "dict":
+                continue              # code lane: legality is card-based
             if not lane_legal(lane, d):
                 self._add(n, "lane",
                           f"column {c!r}: lane {lane!r} cannot carry "
@@ -476,7 +487,50 @@ class _Verifier:
         from .streaming import MORSEL_TABLE
         stats_of = getattr(self.catalog, "col_stats", None)
         if stats_of is not None and not n.table.startswith(MORSEL_TABLE):
-            self.findings.extend(_lane_stat_findings(n, stats_of(n.table)))
+            self.findings.extend(_lane_stat_findings(n, stats_of(n.table),
+                                                     n.encodings))
+
+    def _chk_encodings(self, n: P.ScanNode) -> None:
+        """Encoding metadata legality (static, stats-free): tags well-
+        formed, dict only on dictionary-capable dtypes with a code lane
+        wide enough for the declared cardinality, rle never on bit-packed
+        bool lanes."""
+        if n.encodings is None:
+            return
+        if len(n.encodings) != len(n.columns):
+            self._add(n, "encoding",
+                      f"{len(n.encodings)} encodings vs "
+                      f"{len(n.columns)} columns")
+            return
+        from .jax_backend.device import _LANE_BOUNDS
+        for c, d, lane, enc in zip(n.columns, n.out_dtypes, n.lanes,
+                                   n.encodings):
+            if enc == "plain":
+                continue
+            if not (isinstance(enc, tuple) and len(enc) == 2
+                    and enc[0] in ("dict", "rle")):
+                self._add(n, "encoding",
+                          f"column {c!r}: malformed encoding tag {enc!r}")
+                continue
+            if d in ("str", "bool", "float") and enc[0] == "dict":
+                self._add(n, "encoding",
+                          f"column {c!r}: dict encoding illegal for "
+                          f"dtype {d!r}")
+            if enc[0] == "dict":
+                bounds = _LANE_BOUNDS.get(lane)
+                if bounds is None or int(enc[1]) > bounds[1] + 1:
+                    self._add(n, "encoding",
+                              f"column {c!r}: cardinality {enc[1]} "
+                              f"overflows code lane {lane!r}")
+            if enc[0] == "rle":
+                if lane == "b1":
+                    self._add(n, "encoding",
+                              f"column {c!r}: rle illegal on the "
+                              "bit-packed bool lane")
+                elif int(enc[1]) < 1:
+                    self._add(n, "encoding",
+                              f"column {c!r}: rle runs bound {enc[1]} "
+                              "must be positive")
 
     def _chk_FilterNode(self, n: P.FilterNode, w: int) -> None:
         self._require_passthrough(n, w)
@@ -689,16 +743,21 @@ class _Verifier:
             self._add(n, "scan", "virtual scan without a segment key")
 
 
-def _lane_stat_findings(n: P.ScanNode, stats: dict) -> list[Finding]:
+def _lane_stat_findings(n: P.ScanNode, stats: dict,
+                        encodings=None) -> list[Finding]:
     """Lane-vs-value-range findings for one scan with declared lanes.
     stats: {column: (lo, hi) in engine units, or None = unknown}. Unknown
     ranges only pass on lanes that are range-free for the dtype (the
     widest legal lane); a NARROW lane without stats is itself a finding —
-    nothing proves the column fits."""
+    nothing proves the column fits. Dict-encoded columns are skipped:
+    their lane carries codes bounded by cardinality, not values."""
     from .jax_backend.device import _LANE_BOUNDS, plan_lanes
 
     out: list[Finding] = []
-    for c, d, lane in zip(n.columns, n.out_dtypes, n.lanes):
+    encs = encodings or ("plain",) * len(n.columns)
+    for c, d, lane, enc in zip(n.columns, n.out_dtypes, n.lanes, encs):
+        if isinstance(enc, tuple) and enc[0] == "dict":
+            continue
         bounds = _LANE_BOUNDS.get(lane)
         if bounds is None:      # b1 / f64: dtype legality already checked
             continue
@@ -726,9 +785,54 @@ def check_scan_lanes(scan: P.ScanNode, stats: dict) -> list[Finding]:
     big table's column stats keyed by the scan's column names."""
     if scan.lanes is None:
         return []
-    findings = _lane_stat_findings(scan, stats)
+    findings = _lane_stat_findings(scan, stats, scan.encodings)
     _fill_labels(findings, scan, None)
     return findings
+
+
+def check_scan_encodings(scan: P.ScanNode, enc_stats: dict) -> list[Finding]:
+    """Standalone encoding-vs-stats legality check for a (morsel) scan:
+    every dict/rle spec must be PROVEN against recorded cardinality/run
+    stats before a morsel ships on it — a dictionary smaller than the
+    column's distinct set packs to EncodingOverflowError mid-stream, and a
+    run bound below the recorded total could overflow the static run
+    capacity on an adversarial morsel window. enc_stats: {column:
+    {"distinct": values-or-None, "runs": int-or-None}} from the SAME
+    source the planner chose the encodings from
+    (Session.column_enc_stats)."""
+    if scan.encodings is None:
+        return []
+    out: list[Finding] = []
+    for c, enc in zip(scan.columns, scan.encodings):
+        if enc == "plain" or not isinstance(enc, tuple):
+            continue
+        st = enc_stats.get(c) or {}
+        if enc[0] == "dict":
+            dv = st.get("distinct")
+            if dv is None:
+                out.append(Finding(
+                    scan, "", "encoding",
+                    f"column {c!r}: dict encoding declared but no "
+                    "distinct-value stats prove the dictionary covers it"))
+            elif len(dv) > max(int(enc[1]), 1):
+                out.append(Finding(
+                    scan, "", "encoding",
+                    f"column {c!r}: recorded cardinality {len(dv)} exceeds "
+                    f"the declared dictionary size {enc[1]}"))
+        elif enc[0] == "rle":
+            runs = st.get("runs")
+            if runs is None:
+                out.append(Finding(
+                    scan, "", "encoding",
+                    f"column {c!r}: rle encoding declared but no run-count "
+                    "stats bound the per-morsel run capacity"))
+            elif int(runs) > int(enc[1]):
+                out.append(Finding(
+                    scan, "", "encoding",
+                    f"column {c!r}: recorded run count {runs} exceeds the "
+                    f"declared bound {enc[1]}"))
+    _fill_labels(out, scan, None)
+    return out
 
 
 def check_params(root: P.PlanNode) -> list[Finding]:

@@ -176,3 +176,10 @@ PALLAS_GROUPBY_CALLS = METRICS.counter(
     "pallas_groupby_calls", "fused seg_reduce partial-agg dispatches (pallas)")
 PALLAS_GATHER_CALLS = METRICS.counter(
     "pallas_gather_calls", "VMEM-staged take_many dispatches (pallas)")
+# Encoded execution (device.plan_encodings): dictionary/RLE wire encodings
+DICT_UPLOADS_SAVED = METRICS.counter(
+    "dict_uploads_saved", "device codebook uploads served from the "
+    "per-group cache instead of re-uploading")
+DECODE_SITES = METRICS.counter(
+    "decode_sites", "encoded columns materialized to values (decode_col: "
+    "arithmetic/aggregate/output sites)")

@@ -59,6 +59,21 @@ class ExecStats:
     morsels_per_table: Optional[dict] = None
     narrow_lanes: Optional[bool] = None
     lane_spec: Optional[dict] = None
+    # -- encoded execution (EngineConfig.encoded_exec) -----------------------
+    #: whether dictionary/RLE wire encodings were eligible for this run
+    encoded_exec: Optional[bool] = None
+    #: per-table per-column chosen encoding tags ("plain"/"dict[k]"/"rle[r]")
+    enc_spec: Optional[dict] = None
+    #: upload bytes the encodings removed vs the plain narrow-lane layout
+    enc_bytes_saved: Optional[int] = None
+    #: decode_col sites that materialized values during this run's traces
+    decode_sites: Optional[int] = None
+    #: column slots those decodes materialized (rows x sites) — group keys
+    #: that stay on codes keep this far below morsels x capacity
+    decode_rows: Optional[int] = None
+    #: per-table host-side Arrow->engine morsel decode wall (ms) — the
+    #: staging-thread bottleneck, finally measurable
+    host_decode_ms: Optional[dict] = None
     # -- sharded morsel execution (EngineConfig.mesh_shards) -----------------
     #: data-parallel replica count the streamed groups ran on (None = off)
     mesh_shards: Optional[int] = None
@@ -100,6 +115,12 @@ class ExecStats:
                   fused_groups: int, bytes_uploaded: int,
                   morsels_per_table: dict, narrow_lanes: bool,
                   lane_spec: dict,
+                  encoded_exec: Optional[bool] = None,
+                  enc_spec: Optional[dict] = None,
+                  enc_bytes_saved: Optional[int] = None,
+                  decode_sites: Optional[int] = None,
+                  decode_rows: Optional[int] = None,
+                  host_decode_ms: Optional[dict] = None,
                   prefetch_error_details: Optional[list] = None,
                   fallbacks: Optional[list] = None,
                   mesh_shards: Optional[int] = None,
@@ -115,6 +136,11 @@ class ExecStats:
                    fused_groups=fused_groups, bytes_uploaded=bytes_uploaded,
                    morsels_per_table=dict(morsels_per_table),
                    narrow_lanes=narrow_lanes, lane_spec=dict(lane_spec),
+                   encoded_exec=encoded_exec,
+                   enc_spec=dict(enc_spec) if enc_spec is not None else None,
+                   enc_bytes_saved=enc_bytes_saved,
+                   decode_sites=decode_sites, decode_rows=decode_rows,
+                   host_decode_ms=host_decode_ms,
                    mesh_shards=mesh_shards, sharded_groups=sharded_groups,
                    collective_bytes=collective_bytes,
                    collective_ms=collective_ms,
@@ -135,7 +161,9 @@ class ExecStats:
                   "re_records", "shared_scan", "scan_passes",
                   "tables_streamed", "branches_served", "fused_groups",
                   "bytes_uploaded", "morsels_per_table", "narrow_lanes",
-                  "lane_spec", "mesh_shards", "sharded_groups",
+                  "lane_spec", "encoded_exec", "enc_spec",
+                  "enc_bytes_saved", "decode_sites", "decode_rows",
+                  "host_decode_ms", "mesh_shards", "sharded_groups",
                   "collective_bytes", "collective_ms",
                   "pallas_ops", "pallas_fallback_reason"):
             v = getattr(self, k)

@@ -169,6 +169,7 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
                      late_mat: bool | None = None,
                      shared_scan: bool | None = None,
                      narrow_lanes: bool | None = None,
+                     encoded_exec: bool | None = None,
                      verify_plans: str | None = None,
                      pallas_ops: str | None = None,
                      mesh_shards: int | None = None,
@@ -205,6 +206,9 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
     stopped, keeping the original Power Start Time.
     narrow_lanes: --no_narrow_lanes A/B override (None = config): False
     restores the wide int64 morsel upload layout bit-identically.
+    encoded_exec: --no_encoded_exec A/B override (None = config): False
+    disables the dictionary/RLE wire encodings (streamed morsels ride the
+    plain narrow-lane layout), bit-identical results.
     pallas_ops: comma list of {sort,groupby,gather} enabling the TPU
     Pallas kernel for that op family (None = take EngineConfig.pallas_ops;
     results are bit-identical to the XLA lowering either way).
@@ -237,6 +241,8 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
         config.shared_scan = shared_scan
     if narrow_lanes is not None:  # --no_narrow_lanes A/B override
         config.narrow_lanes = narrow_lanes
+    if encoded_exec is not None:  # --no_encoded_exec A/B override
+        config.encoded_exec = encoded_exec
     if verify_plans is not None:  # --verify_plans override
         config.verify_plans = verify_plans
     if pallas_ops is not None:   # --pallas_ops A/B override
@@ -509,6 +515,13 @@ def main(argv: list[str] | None = None) -> int:
                         "+ bit-packed validity) for A/B runs — morsels "
                         "then ride the wide int64 layout, bit-identical "
                         "results; property: nds.tpu.narrow_lanes")
+    p.add_argument("--no_encoded_exec", action="store_true",
+                   help="disable encoded execution (dictionary/RLE wire "
+                        "encodings chosen from cardinality/run stats, "
+                        "code-space filters/joins/group-bys, per-site "
+                        "decode) for A/B runs — streamed morsels then "
+                        "ride the plain narrow-lane layout, bit-identical "
+                        "results; property: nds.tpu.encoded_exec")
     p.add_argument("--pallas_ops", default=None, metavar="OPS",
                    help="comma list of {sort,groupby,gather}: enable the "
                         "hand-tiled TPU Pallas kernel for that op family "
@@ -546,6 +559,7 @@ def main(argv: list[str] | None = None) -> int:
                      late_mat=False if a.no_late_mat else None,
                      shared_scan=False if a.no_shared_scan else None,
                      narrow_lanes=False if a.no_narrow_lanes else None,
+                     encoded_exec=False if a.no_encoded_exec else None,
                      verify_plans=a.verify_plans,
                      pallas_ops=a.pallas_ops,
                      mesh_shards=a.mesh_shards,

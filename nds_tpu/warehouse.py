@@ -94,6 +94,37 @@ def _file_stats(table: pa.Table) -> dict:
     return out
 
 
+# Per-file encoding stats (cardinality + run counts, engine units) feed
+# encoded-execution planning (Session.column_enc_stats ->
+# device.plan_encodings) the way [min, max] feeds lane planning: computed
+# once at write time with the data in hand, aggregated manifest-first at
+# query time with no data read. Distinct-value lists are capped so the
+# manifest stays small — a column past the cap records only the count
+# (high cardinality: dictionary encoding would not pay anyway).
+ENC_MANIFEST_MAX_DISTINCT = 1024
+
+
+def _enc_file_stats(table: pa.Table) -> dict:
+    from .engine.arrow_bridge import column_enc_stat
+
+    out = {}
+    for name in table.column_names:
+        st = None
+        try:
+            st = column_enc_stat(table.column(name), dec_as_int=True)
+        except Exception:
+            st = None       # stats are an optimization, never a failure
+        if st is None:
+            continue
+        dv = st["distinct"]
+        ent = {"runs": int(st["runs"]), "rows": int(st["rows"]),
+               "distinct_count": None if dv is None else int(len(dv))}
+        if dv is not None and len(dv) <= ENC_MANIFEST_MAX_DISTINCT:
+            ent["distinct"] = [int(v) for v in dv]
+        out[name] = ent
+    return out
+
+
 class WarehouseTable:
     def __init__(self, root: str, name: str):
         self.dir = os.path.join(root, name)
@@ -103,10 +134,12 @@ class WarehouseTable:
     # -- manifest ------------------------------------------------------------
     def _load_doc(self) -> dict:
         if not os.path.exists(self.manifest_path):
-            return {"table": self.name, "snapshots": [], "file_stats": {}}
+            return {"table": self.name, "snapshots": [], "file_stats": {},
+                    "enc_stats": {}}
         with open(self.manifest_path) as f:
             doc = json.load(f)
         doc.setdefault("file_stats", {})
+        doc.setdefault("enc_stats", {})
         return doc
 
     def _load(self) -> list[dict]:
@@ -134,6 +167,8 @@ class WarehouseTable:
         # rollback snapshot may resurrect any older file
         doc["file_stats"].update(getattr(self, "_new_stats", {}))
         self._new_stats = {}
+        doc["enc_stats"].update(getattr(self, "_new_enc_stats", {}))
+        self._new_enc_stats = {}
         self._store_doc(doc)
         return snap
 
@@ -164,6 +199,41 @@ class WarehouseTable:
             agg = parquet_column_stats(list(files), dec_as_int)
         return agg
 
+    def enc_stats(self) -> dict:
+        """{relative file path: {column: {distinct/distinct_count/runs/
+        rows}}} for files written with encoding stats."""
+        return self._load_doc()["enc_stats"]
+
+    def column_enc_stats(self, files) -> dict:
+        """Table-wide encoding stats over the given snapshot files in the
+        Session.column_enc_stats shape: {column: {"distinct": sorted int64
+        array or None, "runs": int}}. Manifest-first, no data read; a
+        column missing stats in ANY file is omitted (no encoding — always
+        safe). Distinct sets union (None when any file only recorded the
+        count — high cardinality); run counts SUM, which bounds the runs
+        of any morsel window under any file order."""
+        import numpy as np
+
+        rec = self.enc_stats()
+        per_file = [rec.get(os.path.relpath(f, self.dir)) for f in files]
+        if not per_file or any(p is None for p in per_file):
+            return {}
+        common = set(per_file[0])
+        for p in per_file[1:]:
+            common &= set(p)
+        out: dict = {}
+        for col in common:
+            ents = [p[col] for p in per_file]
+            distinct = None
+            if all(e.get("distinct") is not None for e in ents):
+                distinct = np.unique(np.concatenate(
+                    [np.asarray(e["distinct"], dtype=np.int64)
+                     for e in ents]))
+            out[col] = {"distinct": distinct,
+                        "runs": sum(int(e["runs"]) for e in ents),
+                        "rows": sum(int(e.get("rows", 0)) for e in ents)}
+        return out
+
     def exists(self) -> bool:
         return os.path.exists(self.manifest_path)
 
@@ -190,6 +260,11 @@ class WarehouseTable:
             if not hasattr(self, "_new_stats"):
                 self._new_stats = {}
             self._new_stats[rel] = stats
+        enc = _enc_file_stats(table)
+        if enc:
+            if not hasattr(self, "_new_enc_stats"):
+                self._new_enc_stats = {}
+            self._new_enc_stats[rel] = enc
         return rel
 
     def _partitioned_files(self, table: pa.Table) -> list[str]:
@@ -320,6 +395,11 @@ class WarehouseTable:
                     if not hasattr(self, "_new_stats"):
                         self._new_stats = {}
                     self._new_stats[new_rel] = st
+                enc = _enc_file_stats(kept)
+                if enc:
+                    if not hasattr(self, "_new_enc_stats"):
+                        self._new_enc_stats = {}
+                    self._new_enc_stats[new_rel] = enc
                 new_files.append(new_rel)
 
         batch_paths: list[str] = []
@@ -383,7 +463,11 @@ class Warehouse:
             files = wt.current_files()
             if not files:
                 continue
-            dataset = pa_dataset.dataset(files, format="parquet")
+            # dictionary-encoded string chunks pass through as codes +
+            # dictionary (arrow_bridge.parquet_dataset_format): the staging
+            # thread stops re-running dictionary_encode() per morsel
+            fmt = arrow_bridge.parquet_dataset_format(files) or "parquet"
+            dataset = pa_dataset.dataset(files, format=fmt)
             dec = session._dec_as_int()
             names, dtypes = arrow_bridge.engine_schema(dataset.schema, dec)
             session._schemas[name] = (names, dtypes)
@@ -406,5 +490,7 @@ class Warehouse:
             session._stats_sources[name] = \
                 lambda wt=wt, files=tuple(files), dec=dec: \
                 wt.column_stats(files, dec)
+            session._enc_stats_sources[name] = session._manifest_enc_source(
+                wt, tuple(files), dataset, dec)
             session._drop_cached(name)
             session._generation += 1

@@ -107,14 +107,15 @@ def test_ci_pipeline_script_runs():
     out = subprocess.run(["bash", script, "--list"], capture_output=True,
                          text=True, check=True)
     assert out.stdout.split() == ["native", "resilience", "static",
-                                  "planner", "kernels", "mesh", "test",
-                                  "bench", "all"]
+                                  "planner", "encoded", "kernels", "mesh",
+                                  "test", "bench", "all"]
     subprocess.run(["bash", script, "native"], check=True, timeout=600)
     import yaml
     with open(os.path.join(repo, "cicd", "ci.yml")) as f:
         wf = yaml.safe_load(f)
     assert set(wf["jobs"]) == {"native", "resilience", "static", "planner",
-                               "kernels", "mesh", "test", "bench"}
+                               "encoded", "kernels", "mesh", "test",
+                               "bench"}
     for job in wf["jobs"].values():
         assert any("run_ci.sh" in str(step.get("run", ""))
                    for step in job["steps"])

@@ -189,6 +189,11 @@ GROUP BY d.grp ORDER BY d.grp
 
 
 def _session(data, narrow, **kw):
+    # encoded_exec off: this suite pins the pure narrow-LANE layout (the
+    # encoding axis on top of it is pinned by tests/test_encoded_exec.py —
+    # with encodings on, low-cardinality columns ride dict code lanes and
+    # these width expectations would legitimately shift)
+    kw.setdefault("encoded_exec", False)
     cfg = EngineConfig(out_of_core=True, chunk_rows=CHUNK,
                        out_of_core_min_rows=10_000, narrow_lanes=narrow,
                        **kw)

@@ -43,6 +43,14 @@
 #                (tests/test_mesh_morsels.py); the GSPMD-compile-heavy
 #                SF0.01 oracle sweep keeps the slow marker and runs in
 #                the full `test` stage so this stage stays in budget
+#   service    - concurrent query service (nds_tpu/service): admission
+#                control + typed rejection, per-tenant deadlines,
+#                batched-dispatch bit-identity vs serial, cross-client
+#                program adoption with flat compile counts, concurrent-
+#                client and live-config-toggle races, service-backed
+#                throughput streams (tests/test_service.py); the
+#                100-client open-loop run carries the slow marker and
+#                runs in the full `test` stage
 #   test       - full pytest suite on an 8-virtual-device CPU mesh
 #   bench      - quick bench slice (SF 0.01) to catch perf regressions early
 #   all        - every stage in order
@@ -119,6 +127,15 @@ stage_mesh() {
         -q -m 'not slow')
 }
 
+stage_service() {
+    # concurrent query service: every response a client receives must be
+    # bit-identical to a fresh single-caller session running the same SQL
+    # — through batched dispatches, the serial lane, deadline-expired
+    # neighbors, and live config toggles
+    (cd "$REPO" && python -m pytest tests/test_service.py \
+        -q -m 'not slow')
+}
+
 stage_test() {
     (cd "$REPO" && python -m pytest tests/ -q --durations=15)
 }
@@ -144,16 +161,16 @@ run_stage() {
 }
 
 case "${1:-all}" in
-    native|resilience|static|planner|encoded|kernels|mesh|test|bench)
+    native|resilience|static|planner|encoded|kernels|mesh|service|test|bench)
         run_stage "$1" ;;
     all)
         total0=$SECONDS
         for s in native resilience static planner encoded kernels mesh \
-                 test bench; do
+                 service test bench; do
             run_stage "$s"
         done
         echo "stage all: $((SECONDS - total0))s" ;;
-    --list)     echo "native resilience static planner encoded kernels mesh test bench all" ;;
-    *) echo "usage: run_ci.sh [native|resilience|static|planner|encoded|kernels|mesh|test|bench|all|--list]" >&2
+    --list)     echo "native resilience static planner encoded kernels mesh service test bench all" ;;
+    *) echo "usage: run_ci.sh [native|resilience|static|planner|encoded|kernels|mesh|service|test|bench|all|--list]" >&2
        exit 2 ;;
 esac

@@ -110,6 +110,23 @@ def clear_shared_programs() -> None:
         _SHARED_PROGRAMS.clear()
 
 
+def shared_fingerprint(pplan, shard_min_rows: int,
+                       pallas_ops: frozenset) -> str:
+    """Registry key of a parameterized unit plan in _SHARED_PROGRAMS.
+
+    Module-level so the query service's PLANNER stage (which must not touch
+    the device-lane executor from its worker threads) computes the same key
+    the executor publishes under: plan structure + the compile-relevant
+    engine configuration (x64 tier, shard threshold, kernel choice)."""
+    import hashlib
+    x64 = jax.config.read("jax_enable_x64")
+    body = _plan_fingerprint(pplan)
+    pk = ",".join(sorted(pallas_ops))
+    return hashlib.sha1(
+        f"{body}|x64={x64}|smr={shard_min_rows}|pallas={pk}"
+        .encode()).hexdigest()
+
+
 def _verify_schedule(decisions: list, checks_host: list) -> None:
     for (kind, planned), actual in zip(decisions, checks_host):
         a = int(actual)
@@ -415,6 +432,90 @@ class CompiledQuery:
         return out_host
 
 
+class BatchedQuery:
+    """One compiled program replayed over a STACKED batch of parameter
+    vectors — the query service's compatible-plan batching unit.
+
+    K admitted queries that parameterize to the same plan fingerprint
+    (same structure, same recorded capacities, same scan tables, different
+    hoisted literal VALUES) are served by a single dispatch: each parameter
+    slot stacks into a (cap,)-vector and ``lax.map`` replays the SAME
+    traced program per row, so row i's computation graph — and therefore
+    its result — is exactly the single-query program's. The batch capacity
+    rides the same ladder as row capacities (device.bucket), bounding the
+    compile count to one batched program per (fingerprint, batch-capacity);
+    short batches pad by duplicating the last real row (identical checks,
+    discarded outputs).
+
+    Schedule checks come back as (cap,)-vectors and verify batch-aware,
+    exactly like sharded-morsel replays (shard_exec): cap decisions check
+    max-over-batch <= bucket, exact decisions check all-equal — any row
+    drifting raises ReplayMismatch and the caller serves the batch
+    serially through the normal record/replay path instead."""
+
+    def __init__(self, cq: CompiledQuery, cap: int):
+        self.cq = cq
+        self.cap = cap
+        self.label = f"{cq.label}@batch{cap}"
+        self._fn = None
+        self._lock = threading.Lock()
+
+    def _trace(self, scan_tuple: tuple, stacked: tuple):
+        def one(params):
+            out, checks = self.cq._trace(scan_tuple, tuple(params))
+            return out, tuple(checks)
+        return lax.map(one, stacked)
+
+    def run(self, scans: dict, rows: list,
+            stats: Optional[dict] = None) -> list:
+        """Run ``rows`` (parameter-value tuples, len <= cap) in ONE
+        dispatch; returns one HOST-side DTable per row (numpy leaves —
+        device_get happens once for the whole stacked output)."""
+        import time as _time
+
+        from ...resilience import FAULTS
+        dts = self.cq.param_dtypes
+        full = list(rows) + [rows[-1]] * (self.cap - len(rows))
+        stacked = tuple(
+            jnp.asarray([r[j] for r in full], dtype=phys_dtype(d))
+            for j, d in enumerate(dts))
+        scan_tuple = tuple(scans[k] for k in self.cq.scan_keys)
+        with self._lock:
+            first = self._fn is None
+            if first:
+                FAULTS.fire("jax.compile")
+                self._fn = jax.jit(self._trace)
+            fn = self._fn
+        if first:
+            _metrics.COMPILES.inc()
+        FAULTS.fire("jax.execute")
+        with TRACER.span("exec", cat="device", label=self.label,
+                         first=first, batch=len(rows)):
+            t1 = _time.perf_counter()
+            with jax.profiler.TraceAnnotation(self.label):
+                out, checks = fn(scan_tuple, stacked)
+                out_host, checks_host = jax.device_get((out, checks))
+            t2 = _time.perf_counter()
+        for (kind, planned), actual in zip(self.cq.decisions, checks_host):
+            a = np.asarray(actual)
+            if kind == "cap":
+                if int(a.max()) > bucket(max(int(planned), 1)):
+                    raise ReplayMismatch(
+                        f"batched capacity overflow: {int(a.max())} > "
+                        f"planned {planned}")
+            elif not bool((a == int(planned)).all()):
+                raise ReplayMismatch(
+                    f"batched exact decision drift: {a.tolist()} != "
+                    f"{planned}")
+        device_ms = round((t2 - t1) * 1000, 3)
+        _PROGRAMS.record_run(self.label, device_ms, first=first)
+        if stats is not None:
+            stats.update(mode="batched", device_ms=device_ms,
+                         batch=len(rows))
+        return [jax.tree_util.tree_map(lambda x: x[i], out_host)
+                for i in range(len(rows))]
+
+
 def _no_load(name: str) -> Table:
     raise NotJittable(f"table load of {name!r} under trace")
 
@@ -496,6 +597,9 @@ class JaxExecutor:
         # fingerprint whose shared program just ReplayMismatched here: the
         # post-mismatch re-record must not re-adopt it (see _adopt_shared)
         self._fp_block: Optional[str] = None
+        # batched compiled programs (query-service compatible-plan
+        # batching): (fingerprint, batch capacity) -> BatchedQuery
+        self._batched: dict = {}
         # Eager (record / fallback) execution runs on the host CPU backend
         # when the default device is an accelerator: per-op dispatch latency
         # through a device tunnel is catastrophic, and the record pass only
@@ -842,13 +946,8 @@ class JaxExecutor:
         is off (mesh runs lower against sharded args; jit disabled)."""
         if self._mesh is not None or not self._jit_plans:
             return None
-        import hashlib
-        x64 = jax.config.read("jax_enable_x64")
-        body = _plan_fingerprint(pplan)
-        pk = ",".join(sorted(self._pallas_ops))
-        return hashlib.sha1(
-            f"{body}|x64={x64}|smr={self._shard_min_rows}|pallas={pk}"
-            .encode()).hexdigest()
+        return shared_fingerprint(pplan, self._shard_min_rows,
+                                  self._pallas_ops)
 
     def _adopt_shared(self, key, fp, pvalues: tuple, pdtypes: tuple) -> bool:
         """Install another stream's entry (schedule + program) for `key`."""
@@ -921,6 +1020,44 @@ class JaxExecutor:
                     and sh.get("cq") is None \
                     and sh["decisions"] == ent["decisions"]:
                 sh["cq"] = ent["cq"]
+
+    def run_param_batch(self, fp: Optional[str], rows: list,
+                        ) -> Optional[list]:
+        """Serve several COMPATIBLE parameterized queries — same shared
+        fingerprint, different hoisted literal values (``rows``) — through
+        one batched dispatch (BatchedQuery: one compiled program over a
+        stacked parameter matrix). Returns one host-side DTable per row,
+        or None when batching is unavailable (no published shared program
+        yet, volatile/nojit entry, parameterless plan, mesh/jit off) — the
+        caller then serves each query through the normal record/replay
+        path. Raises ReplayMismatch when some row's data drifts past the
+        recorded schedule; the caller falls back to serial for that batch
+        (serial re-records and cap-merges the shared entry as usual)."""
+        if fp is None or self._mesh is not None or not self._jit_plans \
+                or not rows:
+            return None
+        with _SHARED_LOCK:
+            sh = _SHARED_PROGRAMS.get(fp)
+            if sh is None or sh.get("volatile") or sh.get("nojit") \
+                    or sh.get("cq") is None or not sh.get("param_dtypes"):
+                return None
+            cq = sh["cq"]
+            scan_meta = dict(sh["scan_meta"])
+        if any(len(r) != len(cq.param_dtypes) for r in rows):
+            return None
+        for k, v in scan_meta.items():
+            self._scan_meta.setdefault(k, v)
+        cap = bucket(len(rows), minimum=1)
+        bq = self._batched.get((fp, cap))
+        if bq is None or bq.cq is not cq:
+            # a re-published program (cap-merged schedule) obsoletes the
+            # batched wrapper: rebuild against the current shared cq
+            bq = BatchedQuery(cq, cap)
+            self._batched[(fp, cap)] = bq
+        self.fallback_nodes = []
+        self.last_stats = {}
+        return bq.run(self._scans_for({"scan_keys": cq.scan_keys}), rows,
+                      stats=self.last_stats)
 
     def _scan_specs(self, ent) -> Optional[tuple]:
         """jax.ShapeDtypeStruct tree mirroring _scans_for(ent) WITHOUT

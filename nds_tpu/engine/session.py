@@ -8,6 +8,7 @@ from Arrow/Parquet and `sql()` parses, plans, and executes on the JAX engine.
 from __future__ import annotations
 
 import os
+import threading
 from typing import Callable, Optional
 
 import pyarrow as pa
@@ -77,6 +78,17 @@ def _and_conjuncts(node):
 class Session:
     def __init__(self, config: Optional[EngineConfig] = None):
         self.config = config or EngineConfig()
+        # -- concurrency contract (the query service, nds_tpu/service) ------
+        # _sql_lock serializes whole statements: sql()/execute() bodies run
+        # one at a time, so the executor, streaming state, and the
+        # last_exec_stats* views stay consistent under multi-threaded entry
+        # (service_run returns result+stats atomically under it).
+        # _lock guards the lazily-built shared caches that CONCURRENT
+        # non-statement work reads/writes — the service's planner threads
+        # hit column_stats/column_enc_stats/load_table while the device
+        # lane executes; both locks are RLocks, ordering _sql_lock -> _lock.
+        self._sql_lock = threading.RLock()
+        self._lock = threading.RLock()
         if self.config.fault_points:
             # arm the engine-level fault registry from config/property file
             # (nds.tpu.fault_points=point:action,...): the resilience layer's
@@ -366,52 +378,58 @@ class Session:
         return list(self._schemas)
 
     def _drop_cached(self, name: str) -> None:
-        for k in [k for k in self._cache if k[0] == name]:
-            del self._cache[k]
-        self._col_stats.pop(name, None)
-        self._enc_stats.pop(name, None)
+        with self._lock:
+            for k in [k for k in self._cache if k[0] == name]:
+                del self._cache[k]
+            self._col_stats.pop(name, None)
+            self._enc_stats.pop(name, None)
 
-    def column_stats(self, name: str) -> dict:
+    def column_stats(self, name: str) -> dict:  # lint: thread-entry (service planner threads read stats concurrently)
         """{column: (lo, hi)} value-range stats in ENGINE units (scaled
         ints for decimals, epoch days for dates) for a registered table;
         {} when the registration has no stats source. Lazily computed and
         cached per registration generation — streaming derives the static
         per-column upload lane spec from these (device.plan_lanes), and the
-        plan verifier proves declared lanes against the same ranges."""
-        if name in self._col_stats:
-            return self._col_stats[name]
-        src = self._stats_sources.get(name)
-        stats = {}
-        if src is not None:
-            try:
-                stats = src() or {}
-            except Exception:
-                stats = {}      # stats are an optimization, never a failure
-        self._col_stats[name] = stats
-        return stats
+        plan verifier proves declared lanes against the same ranges.
+        Thread-safe: the generation cache is read and written under the
+        session state lock (service planner threads race the device lane)."""
+        with self._lock:
+            if name in self._col_stats:
+                return self._col_stats[name]
+            src = self._stats_sources.get(name)
+            stats = {}
+            if src is not None:
+                try:
+                    stats = src() or {}
+                except Exception:
+                    stats = {}  # stats are an optimization, never a failure
+            self._col_stats[name] = stats
+            return stats
 
-    def column_enc_stats(self, name: str, columns=None) -> dict:
+    def column_enc_stats(self, name: str, columns=None) -> dict:  # lint: thread-entry (service planner threads read stats concurrently)
         """{column: {"distinct": sorted int64 array or None, "runs": int}}
         encoding stats for (a subset of) a registered table's columns, in
         ENGINE units; {} when the registration has no encoding-stats
         source. Lazily computed and cached PER COLUMN per registration
         generation — only the columns a scan group actually streams pay
         the (one-time) cardinality/run pass. Feeds device.plan_encodings
-        and the verifier's "encoding" findings."""
-        src = self._enc_stats_sources.get(name)
-        if src is None:
-            return {}
-        if columns is None:
-            columns = self._schemas.get(name, ([], []))[0]
-        cache = self._enc_stats.setdefault(name, {})
-        for c in columns:
-            if c in cache:
-                continue
-            try:
-                cache[c] = src(c)
-            except Exception:
-                cache[c] = None    # stats are an optimization, never fatal
-        return {c: cache[c] for c in columns if cache.get(c)}
+        and the verifier's "encoding" findings. Thread-safe like
+        column_stats: cache writes happen under the session state lock."""
+        with self._lock:
+            src = self._enc_stats_sources.get(name)
+            if src is None:
+                return {}
+            if columns is None:
+                columns = self._schemas.get(name, ([], []))[0]
+            cache = self._enc_stats.setdefault(name, {})
+            for c in columns:
+                if c in cache:
+                    continue
+                try:
+                    cache[c] = src(c)
+                except Exception:
+                    cache[c] = None  # stats are an optimization, never fatal
+            return {c: cache[c] for c in columns if cache.get(c)}
 
     @staticmethod
     def _manifest_enc_source(wt, files, dataset, dec):
@@ -475,23 +493,26 @@ class Session:
         for part in emit(batches):
             yield arrow_bridge.from_arrow(part, self._dec_as_int())
 
-    def load_table(self, name: str, columns=None) -> Table:
+    def load_table(self, name: str, columns=None) -> Table:  # lint: thread-entry (streaming staging threads + service lanes load concurrently)
         """Load a table, optionally projected to `columns` (scan pruning:
         fact tables carry ~23 columns but a query touches a handful — the
         reference gets this from parquet column projection in Spark scans).
-        Cached per projection; a cached full table serves any subset."""
-        key = (name, tuple(columns) if columns is not None else None)
-        if key in self._cache:
+        Cached per projection; a cached full table serves any subset.
+        Thread-safe: the projection cache is populated under the session
+        state lock (staging threads and service lanes load concurrently)."""
+        with self._lock:
+            key = (name, tuple(columns) if columns is not None else None)
+            if key in self._cache:
+                return self._cache[key]
+            if columns is not None and (name, None) in self._cache:
+                full = self._cache[(name, None)]
+                idx = {n: i for i, n in enumerate(full.names)}
+                sub = Table(list(columns),
+                            [full.columns[idx[c]] for c in columns])
+                self._cache[key] = sub
+                return sub
+            self._cache[key] = self._loaders[name](columns)
             return self._cache[key]
-        if columns is not None and (name, None) in self._cache:
-            full = self._cache[(name, None)]
-            idx = {n: i for i, n in enumerate(full.names)}
-            sub = Table(list(columns),
-                        [full.columns[idx[c]] for c in columns])
-            self._cache[key] = sub
-            return sub
-        self._cache[key] = self._loaders[name](columns)
-        return self._cache[key]
 
     # -- query --------------------------------------------------------------
     def _catalog(self) -> Catalog:
@@ -504,7 +525,7 @@ class Session:
                        verify_plans=self.config.verify_plans,
                        stats_source=self.column_stats)
 
-    def sql(self, query: str, backend: Optional[str] = None,
+    def sql(self, query: str, backend: Optional[str] = None,  # lint: thread-entry (service clients call sql concurrently)
             label: Optional[str] = None) -> Table:
         """Run a query; backend "jax" (device) or "numpy" (host oracle).
 
@@ -516,9 +537,46 @@ class Session:
         label: human-stable query name for observability (runners pass
         "query9" etc.); spans and per-program device-time attribution key
         on it. Defaults to a short content hash of the SQL text.
+
+        Thread-safe: concurrent callers serialize on _sql_lock (whole
+        statements are the unit). Note last_exec_stats* describe the last
+        COMPLETED statement of ANY caller — concurrent callers wanting
+        their own stats use service_run (result + stats atomically).
         """
+        with self._sql_lock:
+            return self._sql_locked(query, backend, label)
+
+    def abandon_inflight(self) -> None:
+        """A deadline just ABANDONED a worker thread mid-statement
+        (resilience.run_with_deadline: python threads cannot be killed).
+        The zombie may still hold this session's statement/state locks —
+        install fresh ones so the stream continues immediately instead of
+        queueing behind the zombie's hang. The zombie then races the next
+        statement exactly as it did before the locks existed (the
+        documented containment posture: bounded by the hang, the caller
+        already recorded the query Failed); runners that cannot accept
+        that race should use process isolation (throughput process mode).
+        """
+        self._sql_lock = threading.RLock()
+        self._lock = threading.RLock()
+
+    def service_run(self, query: str, backend: Optional[str] = None,
+                    label: Optional[str] = None, plan=None):
+        """Query-service entry: like sql() but returns (Table, ExecStats)
+        ATOMICALLY (per-query state isolation under multi-client entry —
+        reading last_exec_stats after sql() returns races other clients),
+        and accepts a pre-built plan from the service's planner stage so
+        a first-sighting execution skips re-parsing/re-planning."""
+        with self._sql_lock:
+            table = self._sql_locked(query, backend, label, plan=plan)
+            return table, self.last_exec_stats_typed
+
+    def _sql_locked(self, query: str, backend: Optional[str],
+                    label: Optional[str], plan=None) -> Table:
         use_jax = (backend == "jax") if backend else self.config.use_jax
         self.last_fallbacks = []
+        self.last_exec_stats = {}
+        self.last_exec_stats_typed = None
         self._active_label = label or self._auto_label(query)
         _metrics.QUERIES_RUN.inc()
         with TRACER.span("query", label=self._active_label,
@@ -533,6 +591,8 @@ class Session:
                 jexec.query_label = self._active_label
 
                 def factory():
+                    if plan is not None:
+                        return plan
                     with TRACER.span("plan", label=self._active_label):
                         with TRACER.span("parse"):
                             ast = parse_sql(query)
@@ -546,7 +606,9 @@ class Session:
                     jexec.last_stats, self.last_fallbacks))
                 return result
             with TRACER.span("plan", label=self._active_label):
-                plan = Planner(self._catalog()).plan_query(parse_sql(query))
+                if plan is None:
+                    plan = Planner(self._catalog()).plan_query(
+                        parse_sql(query))
             executor = Executor(self.load_table)
             return executor.execute(plan)
 
@@ -605,7 +667,7 @@ class Session:
                 int(cfg.mesh_shards or 0),
                 tuple(sorted(cfg.pallas_ops)))
 
-    def _sql_streaming(self, query: str):
+    def _sql_streaming(self, query: str):  # lint: thread-entry (called under _sql_lock; stream-cache writes additionally take the state lock)
         """Out-of-core execution (generalized round 5, shared-scan round 7):
         every MAXIMAL streamable aggregate subtree in the plan — top-level,
         below joins, inside CTE bodies, scalar subqueries, with UNION ALL
@@ -624,11 +686,11 @@ class Session:
         from . import streaming
 
         cfg_key = self._stream_config_key()
-        if self._stream_cache_cfg != cfg_key:
-            self._stream_cache = {}
-            self._stream_cache_cfg = cfg_key
-
-        sent = self._stream_cache.get(query, "miss")
+        with self._lock:
+            if self._stream_cache_cfg != cfg_key:
+                self._stream_cache = {}
+                self._stream_cache_cfg = cfg_key
+            sent = self._stream_cache.get(query, "miss")
         if sent is None:          # known not-streamable: skip the re-plan
             return None
         if sent == "miss":
@@ -637,7 +699,8 @@ class Session:
                 plan, lambda t: self._est_rows.get(t, 0),
                 self.config.out_of_core_min_rows)
             if not jobs:
-                self._stream_cache[query] = None
+                with self._lock:
+                    self._stream_cache[query] = None
                 return None
             groups = streaming.plan_scan_groups(jobs,
                                                 self.config.shared_scan)
@@ -677,7 +740,8 @@ class Session:
                     "exec": shared,
                     "gstates": [{"cqs": None, "ents": None, "fused": False}
                                 for _ in groups]}
-            self._stream_cache[query] = sent
+            with self._lock:
+                self._stream_cache[query] = sent
 
         plan, jobs, groups = sent["plan"], sent["jobs"], sent["groups"]
         from .jax_backend.device import decode_stats
@@ -709,7 +773,8 @@ class Session:
             out = self._stream_group(group, sent["exec"], gstate, sinks,
                                      prefetch_errs, shard_stats)
             if out is None:
-                self._stream_cache[query] = None
+                with self._lock:
+                    self._stream_cache[query] = None
                 return None     # not device-runnable: in-core path
             morsels_run, rr, ub, sharded, host_ms = out
             total_morsels += morsels_run
@@ -730,7 +795,8 @@ class Session:
                     enc_lane_bytes(group.lanes, cap, group.encodings))
         for ji, job in enumerate(jobs):
             if not partials[ji]:
-                self._stream_cache[query] = None
+                with self._lock:
+                    self._stream_cache[query] = None
                 return None
             with TRACER.span("merge.partials", job=ji,
                              parts=len(partials[ji])):
@@ -1116,7 +1182,12 @@ class Session:
 
     def execute(self, sql_text: str, backend: Optional[str] = None):
         """Execute one or more ';'-separated statements; returns the last
-        query's Table (or None for pure DML)."""
+        query's Table (or None for pure DML). Serialized on _sql_lock like
+        sql() — statements are the unit of the concurrency contract."""
+        with self._sql_lock:
+            return self._execute_locked(sql_text, backend)
+
+    def _execute_locked(self, sql_text: str, backend: Optional[str]):
         from ..sql import parse_statements
         from ..sql.ast_nodes import CreateView, Delete, DropView, Insert, Query
 

@@ -183,3 +183,24 @@ DICT_UPLOADS_SAVED = METRICS.counter(
 DECODE_SITES = METRICS.counter(
     "decode_sites", "encoded columns materialized to values (decode_col: "
     "arithmetic/aggregate/output sites)")
+# Concurrent query service (nds_tpu/service): admission, queueing, batching
+SERVICE_ADMITTED = METRICS.counter(
+    "service_admitted", "queries accepted into the service queue")
+SERVICE_REJECTED = METRICS.counter(
+    "service_rejected", "queries refused at admission (queue full / "
+    "service closed) — typed AdmissionRejected, never a pile-up")
+SERVICE_DEADLINE_EXPIRED = METRICS.counter(
+    "service_deadline_expired", "admitted queries whose per-tenant "
+    "deadline expired before execution started (typed DeadlineExceeded)")
+SERVICE_BATCHES = METRICS.counter(
+    "service_batches", "batched dispatches: one compiled program served "
+    "a stacked parameter matrix for several compatible queries")
+SERVICE_BATCHED_QUERIES = METRICS.counter(
+    "service_batched_queries", "queries served through a batched dispatch "
+    "(including parameter-identical duplicates deduplicated in-batch)")
+SERVICE_QUEUE_WAIT_MS = METRICS.counter(
+    "service_queue_wait_ms", "total wall (ms) admitted queries spent "
+    "waiting between admission and execution start")
+SERVICE_QUEUE_DEPTH = METRICS.gauge(
+    "service_queue_depth", "queries currently admitted but not finished "
+    "(the admission-control pressure signal)")

@@ -88,6 +88,13 @@ class ExecStats:
     pallas_ops: Optional[list] = None
     #: why the XLA lowering served despite the flag (platform/import/mesh)
     pallas_fallback_reason: Optional[str] = None
+    # -- query service (nds_tpu/service) -------------------------------------
+    #: wall spent between service admission and execution start (ms) — the
+    #: service-mode latency decomposition: latency = queue_wait + execute
+    queue_wait_ms: Optional[float] = None
+    #: co-served queries: how many OTHER admitted queries rode the same
+    #: compiled dispatch (compatible-plan batching); None = not batched
+    batched_with: Optional[int] = None
     # -- failure observability -----------------------------------------------
     fallback_reasons: list = field(default_factory=list)
     #: EVERY staging-thread failure of the run ("Type: message"), not just
@@ -165,7 +172,8 @@ class ExecStats:
                   "enc_bytes_saved", "decode_sites", "decode_rows",
                   "host_decode_ms", "mesh_shards", "sharded_groups",
                   "collective_bytes", "collective_ms",
-                  "pallas_ops", "pallas_fallback_reason"):
+                  "pallas_ops", "pallas_fallback_reason",
+                  "queue_wait_ms", "batched_with"):
             v = getattr(self, k)
             if v is not None:
                 out[k] = v

@@ -344,8 +344,17 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
                 return run_one_query(*a, **k)
 
             def attempt_fn(*a, _name=name, **k):
-                return run_with_deadline(run_fn, timeout_s, *a,
-                                         label=_name, **k)
+                from .resilience import DeadlineExceeded
+                try:
+                    return run_with_deadline(run_fn, timeout_s, *a,
+                                             label=_name, **k)
+                except DeadlineExceeded:
+                    # the abandoned worker may still hold the session's
+                    # statement lock (it cannot be killed): swap in fresh
+                    # locks so the NEXT query runs now instead of queueing
+                    # behind the zombie's hang
+                    session.abandon_inflight()
+                    raise
 
             if not _injected(name):
                 for _ in range(warmup if name in failed_records

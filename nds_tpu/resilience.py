@@ -13,6 +13,10 @@ module supplies the primitives the runners build on:
   query or a stream; a budget overrun raises :class:`DeadlineExceeded`
   (the worker thread is abandoned, not killed — the caller records the
   failure and moves on).
+- :class:`AdmissionRejected` — typed overload rejection raised by bounded
+  admission points (the query service's bounded queue, ``nds_tpu/service``)
+  so overload surfaces as an immediate, classifiable error instead of an
+  unbounded pile-up behind the accelerator.
 - :class:`FaultRegistry` — named engine-level fault points
   (``arrow.read``, ``device.put``, ``jax.compile``, ``jax.execute``,
   ``stream.spawn``, ``query.run``) threaded through the engine and
@@ -46,6 +50,20 @@ class TransientError(RuntimeError):
 
 class DeadlineExceeded(RuntimeError):
     """A per-query or per-stream wall-clock budget expired."""
+
+
+class AdmissionRejected(RuntimeError):
+    """A query was refused at a bounded admission point (service queue full,
+    service closed) INSTEAD of piling up behind the accelerator. Carries the
+    observed depth/limit so clients can back off proportionally; classified
+    transient by RetryPolicy (retry-after-backoff is the intended client
+    response to overload)."""
+
+    def __init__(self, message: str, depth: int | None = None,
+                 limit: int | None = None):
+        super().__init__(message)
+        self.depth = depth
+        self.limit = limit
 
 
 # -- retry --------------------------------------------------------------------

@@ -1,0 +1,22 @@
+"""Concurrent query service: admission-controlled async execution over one
+shared Session/device mesh (ROADMAP item 4 — the interactive multi-user
+shape "Accelerating Presto with GPUs" demonstrates, PAPERS.md).
+
+Public surface:
+
+- :class:`QueryService` — the long-lived in-process service: bounded
+  admission queue, planner worker threads overlapping host-side parse/plan
+  with device execution, a single device lane, and capacity-ladder-aware
+  batching of compatible parameterized plans.
+- :class:`ServiceConfig` — admission limits, worker counts, per-tenant
+  deadlines, batching knobs.
+- :class:`Ticket` — one submitted query's async handle (``result()``).
+- typed failures: :class:`~nds_tpu.resilience.AdmissionRejected` (queue
+  full / closed) and :class:`~nds_tpu.resilience.DeadlineExceeded`
+  (per-tenant deadline expired while queued).
+"""
+from ..resilience import AdmissionRejected, DeadlineExceeded
+from .service import QueryService, ServiceConfig, Ticket
+
+__all__ = ["QueryService", "ServiceConfig", "Ticket",
+           "AdmissionRejected", "DeadlineExceeded"]

@@ -1,0 +1,565 @@
+"""The in-process concurrent query service.
+
+Everything the engine measured before this module was batch-shaped: one
+caller per Session, one query at a time, the device idle between a query's
+host merge and the next query's staging. The service converts that into a
+concurrency contract across the existing layers:
+
+- **Admission control** (``submit``): a bounded pending count — overload
+  raises a typed :class:`~nds_tpu.resilience.AdmissionRejected` at the
+  door instead of piling queries up behind the accelerator. Per-tenant
+  wall-clock budgets map onto :class:`~nds_tpu.resilience.Deadline`; a
+  query whose budget expires while queued fails typed
+  (:class:`~nds_tpu.resilience.DeadlineExceeded`) while its neighbors
+  complete.
+- **Pipelined scheduling**: planner worker threads parse/plan/parameterize
+  queued queries (pure host-side Python) CONCURRENTLY with the device
+  lane executing earlier queries — XLA dispatch releases the GIL, so one
+  query's planning genuinely overlaps another's device execution. A
+  cross-client plan cache keyed by SQL text + the session's streaming
+  config fingerprint means repeated dashboard-style texts plan once.
+- **Shared program cache**: execution reuses the session's JaxExecutor and
+  the process-wide ``_SHARED_PROGRAMS`` registry (cross-stream adoption by
+  parameterized-plan fingerprint, PERF.md round 5) — the Nth client
+  running a template re-traces and re-compiles NOTHING, whichever client
+  compiled first.
+- **Compatible-plan batching**: ready queries that parameterize to the
+  same plan fingerprint are served through ONE compiled program over a
+  stacked parameter matrix (``executor.BatchedQuery``: ``lax.map`` over
+  the capacity-ladder-padded batch; parameter-identical duplicates
+  deduplicate to a single row). Row i's computation graph is exactly the
+  single-query program's, so results are bit-identical to serial
+  execution; any schedule drift falls the batch back to the normal
+  record/replay path.
+
+The device lane is ONE thread: the accelerator executes one program at a
+time anyway, and a single lane keeps the session executor's state
+single-writer (Session serializes statements on ``_sql_lock`` for safety,
+so even direct ``session.sql`` callers stay correct beside the service).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import OrderedDict, deque
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..obs import metrics as _metrics
+from ..obs.stats import ExecStats
+from ..obs.trace import TRACER
+from ..resilience import AdmissionRejected, Deadline, DeadlineExceeded
+
+
+class ServiceClosed(AdmissionRejected):
+    """Submitted to a service that is not running (never started, closing,
+    or closed) — a typed admission failure, retryable against a restarted
+    service."""
+
+
+@dataclass
+class ServiceConfig:
+    """Knobs of one QueryService instance (engine knobs stay on
+    EngineConfig — the service composes a Session, it does not own one)."""
+    #: admitted-but-unfinished queries the service holds before refusing
+    #: new work (typed AdmissionRejected). The pressure valve: clients see
+    #: overload immediately and back off instead of stacking latency.
+    max_pending: int = 256
+    #: planner worker threads (parse/plan/parameterize). Host-side Python:
+    #: more than a few buys little under the GIL, but >= 2 keeps planning
+    #: flowing while one worker waits on cold column-stats reads.
+    plan_workers: int = 2
+    #: default per-query wall budget in seconds (0 = unbounded), measured
+    #: from ADMISSION — queue wait spends the budget, so an overloaded
+    #: service sheds stale work instead of executing it late.
+    default_deadline_s: float = 0.0
+    #: per-tenant deadline overrides: {tenant: seconds}
+    tenant_deadlines: dict = field(default_factory=dict)
+    #: serve compatible parameterized plans through one batched dispatch
+    batching: bool = True
+    #: most queries coalesced into one batched dispatch (the stacked
+    #: parameter matrix pads to the capacity ladder above this count's
+    #: bucket, so the knob also bounds compiled batch shapes)
+    max_batch: int = 16
+    #: after the first ready query is picked up, wait this long for more
+    #: compatible arrivals before dispatching (0 = serve whatever is
+    #: already queued; open-loop load keeps the queue nonempty by itself)
+    batch_linger_ms: float = 0.0
+    #: cross-client plan-cache entries (SQL text -> planned query); LRU
+    plan_cache_entries: int = 512
+
+
+class Ticket:
+    """One submitted query's handle. The service hands the ticket through
+    its stages (admission -> planner worker -> device lane); each stage is
+    the ticket's sole owner while it holds it, and ``result()`` is the
+    client-side rendezvous."""
+
+    def __init__(self, query: str, label: str, tenant: str,
+                 deadline: Deadline, backend: Optional[str]):
+        self.query = query
+        self.label = label
+        self.tenant = tenant
+        self.deadline = deadline
+        self.backend = backend
+        self.submitted_at = time.perf_counter()
+        #: wall between admission and execution start (ms); lands in stats
+        self.queue_wait_ms: Optional[float] = None
+        #: per-query ExecStats (queue_wait_ms/batched_with included)
+        self.stats: Optional[ExecStats] = None
+        # planner-stage products
+        self.plan = None
+        self.fp: Optional[str] = None
+        self.pvalues: tuple = ()
+        self.use_jax = True
+        self._done = threading.Event()
+        self._result = None
+        self._materialize = None
+        self._mat_lock = threading.Lock()
+        self._error: Optional[BaseException] = None
+
+    # -- stage transitions (methods so stage loops stay lint-clean:
+    #    single-owner handoff, no shared-state writes in thread targets) --
+    def set_planned(self, plan, fp, pvalues, use_jax) -> None:
+        self.plan = plan
+        self.fp = fp
+        self.pvalues = tuple(pvalues)
+        self.use_jax = use_jax
+
+    def mark_started(self) -> float:
+        """Execution starts now: record + return the queue wait (ms)."""
+        self.queue_wait_ms = round(
+            (time.perf_counter() - self.submitted_at) * 1000.0, 3)
+        return self.queue_wait_ms
+
+    def finish(self, result, stats: Optional[ExecStats],
+               materialize=None) -> None:
+        """materialize: optional deferred host-side conversion applied in
+        result() on the CLIENT's thread — the device lane hands out raw
+        per-row outputs and N clients materialize their Tables in
+        parallel instead of serializing that work behind the lane."""
+        self._result = result
+        self._materialize = materialize
+        self.stats = stats
+        self._done.set()
+
+    def fail(self, error: BaseException) -> None:
+        self._error = error
+        self._done.set()
+
+    # -- client side ---------------------------------------------------------
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        """Block until the query finishes; returns its Table or raises the
+        typed failure (AdmissionRejected subclasses are raised by submit()
+        itself — here land DeadlineExceeded, parse/plan/execution errors).
+        Tables are READ-ONLY: parameter-identical queries served by one
+        batched row share the same materialized object."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"query {self.label!r} not finished within {timeout}s")
+        if self._error is not None:
+            raise self._error
+        with self._mat_lock:
+            if self._materialize is not None:
+                self._result = self._materialize(self._result)
+                self._materialize = None
+        return self._result
+
+
+class _PlannedQuery:
+    """Cross-client plan-cache entry for one SQL text."""
+    __slots__ = ("plan", "fp", "pvalues", "streams")
+
+    def __init__(self, plan, fp, pvalues, streams):
+        self.plan = plan
+        self.fp = fp
+        self.pvalues = tuple(pvalues)
+        self.streams = streams
+
+
+class QueryService:
+    """Long-lived async query service over one shared Session.
+
+    Usage::
+
+        svc = QueryService(session)           # or ServiceConfig(...)
+        with svc:                             # start()/close()
+            t = svc.submit("SELECT ...", tenant="dash", label="q1")
+            table = t.result()
+            # or synchronously:
+            table = svc.sql("SELECT ...")
+
+    Registrations should be quiesced while the service is running (the
+    catalog generation invalidates caches correctly, but a registration
+    racing an in-flight plan can produce a stale-plan failure the client
+    must retry)."""
+
+    def __init__(self, session, config: Optional[ServiceConfig] = None):
+        self.session = session
+        self.config = config or ServiceConfig()
+        self._cv = threading.Condition()
+        self._intake: deque = deque()     # admitted, awaiting planning
+        self._ready: deque = deque()      # planned, awaiting the device lane
+        self._pending = 0                 # admitted but unfinished
+        self._plan_cache: "OrderedDict" = OrderedDict()
+        self._plan_cache_key = None       # config/generation fingerprint
+        self._hold = False                # test/drain hook: park the lane
+        self._running = False
+        self._threads: list[threading.Thread] = []
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "QueryService":
+        with self._cv:
+            if self._running:
+                return self
+            self._running = True
+        n = max(1, self.config.plan_workers)
+        self._threads = [
+            threading.Thread(target=self._plan_worker, daemon=True,
+                             name=f"svc-planner-{i}") for i in range(n)
+        ] + [threading.Thread(target=self._device_loop, daemon=True,
+                              name="svc-device-lane")]
+        for t in self._threads:
+            t.start()
+        return self
+
+    def close(self, drain: bool = True) -> None:
+        """Stop the service. drain=True (default) finishes admitted work
+        first; drain=False fails queued-but-unstarted tickets typed."""
+        with self._cv:
+            if not self._running:
+                return
+            if drain:
+                while self._pending > 0:
+                    self._cv.wait(0.05)
+            self._running = False
+            dropped = list(self._intake) + list(self._ready)
+            self._intake.clear()
+            self._ready.clear()
+            self._cv.notify_all()
+        for t in dropped:
+            self._finish_ticket(t, error=ServiceClosed(
+                f"service closed before {t.label!r} executed"))
+        for t in self._threads:
+            t.join(timeout=10)
+        self._threads = []
+
+    def __enter__(self) -> "QueryService":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close(drain=not any(exc))
+
+    @contextlib.contextmanager
+    def hold_dispatch(self):
+        """Park the device lane (planning continues): deterministic batch
+        accumulation for tests and drain windows."""
+        with self._cv:
+            self._hold = True
+        try:
+            yield
+        finally:
+            with self._cv:
+                self._hold = False
+                self._cv.notify_all()
+
+    # -- admission -----------------------------------------------------------
+    def submit(self, query: str, label: Optional[str] = None,
+               tenant: str = "default",
+               deadline_s: Optional[float] = None,
+               backend: Optional[str] = None) -> Ticket:
+        """Admit one query; returns its Ticket immediately.
+
+        Raises AdmissionRejected (typed, with depth/limit) when the bounded
+        pending set is full or the service is closed — overload is an
+        immediate, classifiable signal, never a silent pile-up. The
+        query's deadline (explicit > tenant override > default) starts
+        NOW: queue wait spends it."""
+        cfg = self.config
+        if deadline_s is None:
+            deadline_s = cfg.tenant_deadlines.get(
+                tenant, cfg.default_deadline_s)
+        ticket = Ticket(query, label or self._auto_label(query), tenant,
+                        Deadline(deadline_s), backend)
+        with self._cv:
+            if not self._running:
+                _metrics.SERVICE_REJECTED.inc()
+                raise ServiceClosed("query service is not running")
+            if self._pending >= cfg.max_pending:
+                _metrics.SERVICE_REJECTED.inc()
+                raise AdmissionRejected(
+                    f"admission queue full: {self._pending} pending >= "
+                    f"max_pending {cfg.max_pending}",
+                    depth=self._pending, limit=cfg.max_pending)
+            self._pending += 1
+            _metrics.SERVICE_ADMITTED.inc()
+            _metrics.SERVICE_QUEUE_DEPTH.set(self._pending)
+            self._intake.append(ticket)
+            self._cv.notify_all()
+        return ticket
+
+    def sql(self, query: str, label: Optional[str] = None,
+            tenant: str = "default", deadline_s: Optional[float] = None,
+            backend: Optional[str] = None,
+            timeout: Optional[float] = None):
+        """Synchronous convenience: submit + result."""
+        return self.submit(query, label=label, tenant=tenant,
+                           deadline_s=deadline_s,
+                           backend=backend).result(timeout)
+
+    @staticmethod
+    def _auto_label(query: str) -> str:
+        import hashlib
+        return "q" + hashlib.sha1(query.encode()).hexdigest()[:8]
+
+    # -- planner stage -------------------------------------------------------
+    def _plan_worker(self) -> None:
+        while True:
+            with self._cv:
+                while self._running and not self._intake:
+                    self._cv.wait(0.1)
+                if not self._running:
+                    return
+                ticket = self._intake.popleft()
+            if self._expire_if_late(ticket, "queued"):
+                continue
+            try:
+                with TRACER.span("service.plan", label=ticket.label):
+                    self._plan_ticket(ticket)
+            except Exception as e:
+                self._finish_ticket(ticket, error=e)
+                continue
+            with self._cv:
+                self._ready.append(ticket)
+                self._cv.notify_all()
+
+    def _plan_ticket(self, ticket: Ticket) -> None:
+        """Parse/plan/parameterize one query via the cross-client plan
+        cache. Runs on planner threads: touches only the session's
+        lock-protected read surfaces (catalog schemas, column stats)."""
+        from ..sql import parse_sql
+        from ..engine.planner import Planner
+        from ..engine import streaming
+        from ..engine.jax_backend import pallas_kernels as _pk
+        from ..engine.jax_backend.executor import shared_fingerprint
+        from ..engine.plan import parameterize_plan
+
+        session = self.session
+        cfg = session.config
+        use_jax = (ticket.backend == "jax") if ticket.backend \
+            else cfg.use_jax
+        cache_key = session._stream_config_key()
+        with self._cv:
+            if self._plan_cache_key != cache_key:
+                self._plan_cache.clear()
+                self._plan_cache_key = cache_key
+            entry = self._plan_cache.get(ticket.query)
+            if entry is not None:
+                self._plan_cache.move_to_end(ticket.query)
+        if entry is None:
+            plan = Planner(session._catalog()).plan_query(
+                parse_sql(ticket.query))
+            streams = False
+            if use_jax and cfg.out_of_core:
+                jobs = streaming.find_streaming_jobs(
+                    plan, lambda t: session._est_rows.get(t, 0),
+                    cfg.out_of_core_min_rows)
+                streams = bool(jobs)
+            fp = None
+            pvalues: tuple = ()
+            if use_jax and not streams and cfg.jit_plans \
+                    and not cfg.mesh_shape:
+                # the batching identity: two texts whose parameterized
+                # plans share this fingerprint differ only in hoisted
+                # literal VALUES — one compiled program serves both
+                pplan, pvals, pdts = parameterize_plan(plan)
+                if pdts:
+                    fp = shared_fingerprint(
+                        pplan, cfg.shard_min_rows,
+                        _pk.parse_ops(cfg.pallas_ops))
+                    pvalues = tuple(pvals)
+            entry = _PlannedQuery(plan, fp, pvalues, streams)
+            with self._cv:
+                self._plan_cache[ticket.query] = entry
+                while len(self._plan_cache) > self.config.plan_cache_entries:
+                    self._plan_cache.popitem(last=False)
+        ticket.set_planned(entry.plan, None if entry.streams else entry.fp,
+                           entry.pvalues, use_jax)
+
+    # -- device lane ---------------------------------------------------------
+    def _device_loop(self) -> None:
+        while True:
+            batch = self._next_batch()
+            if batch is None:
+                return
+            if not batch:
+                continue
+            try:
+                self._serve(batch)
+            except BaseException as e:  # lane must never die with clients waiting
+                for t in batch:
+                    if not t.done():
+                        self._finish_ticket(t, error=e)
+                if isinstance(e, (KeyboardInterrupt, SystemExit)):
+                    raise
+
+    def _next_batch(self) -> Optional[list]:
+        cfg = self.config
+        with self._cv:
+            while self._running and (self._hold or not self._ready):
+                self._cv.wait(0.05)
+            if not self._running:
+                return None
+        if cfg.batch_linger_ms > 0:
+            time.sleep(cfg.batch_linger_ms / 1000.0)
+        with self._cv:
+            out = []
+            while self._ready and len(out) < max(1, cfg.max_batch):
+                out.append(self._ready.popleft())
+            return out
+
+    def _serve(self, batch: list) -> None:
+        """Execute one drained window: expire late tickets, coalesce
+        compatible parameterized plans into batched dispatches, serve the
+        rest serially in arrival order."""
+        live = []
+        for t in batch:
+            if not self._expire_if_late(t, "waiting for the device lane"):
+                live.append(t)
+        groups: "OrderedDict[str, list]" = OrderedDict()
+        serial: list = []
+        for t in live:
+            if self.config.batching and t.fp is not None and t.use_jax:
+                groups.setdefault(t.fp, []).append(t)
+            else:
+                serial.append(t)
+        for fp, members in groups.items():
+            if len(members) < 2:
+                serial.extend(members)
+                continue
+            if not self._serve_batched(fp, members):
+                serial.extend(members)
+        for t in serial:
+            self._serve_serial(t)
+
+    def _serve_batched(self, fp: str, members: list) -> bool:
+        """One compiled program over the group's stacked parameter vectors;
+        parameter-identical members deduplicate to one row. Returns False
+        when batching is unavailable/drifted — the caller serves the group
+        serially (which also records/compiles the shared program the NEXT
+        batch of this template will ride)."""
+        from ..engine.jax_backend.device import to_host
+
+        session = self.session
+        rows: list[tuple] = []
+        index: dict[tuple, int] = {}
+        member_rows = []
+        for t in members:
+            i = index.get(t.pvalues)
+            if i is None:
+                i = index[t.pvalues] = len(rows)
+                rows.append(t.pvalues)
+            member_rows.append(i)
+        waits = [t.mark_started() for t in members]
+        with session._sql_lock:
+            jexec = session._jax_executor()
+            try:
+                with TRACER.span("service.batch", label=members[0].label,
+                                 queries=len(members), rows=len(rows)):
+                    outs = jexec.run_param_batch(fp, rows)
+            except Exception:
+                # schedule drift (ReplayMismatch), trace failure, transient
+                # runtime error: the serial path both surfaces any genuine
+                # per-query failure and repairs the shared entry
+                outs = None
+            if outs is None:
+                for t in members:     # serial path re-measures queue wait
+                    t.queue_wait_ms = None
+                return False
+            exec_stats = dict(jexec.last_stats)
+        device_ms = exec_stats.get("device_ms")
+        _metrics.SERVICE_BATCHES.inc()
+        _metrics.SERVICE_BATCHED_QUERIES.inc(len(members))
+        _metrics.QUERIES_RUN.inc(len(members))
+        cells: dict[int, tuple] = {}
+
+        def shared_cell(ri):
+            # parameter-identical tickets share ONE materialized Table:
+            # the row was computed once, so it converts once too (first
+            # result() call wins, the rest reuse) — and conversion happens
+            # on client threads, not behind the device lane
+            if ri not in cells:
+                cell = {"dt": outs[ri], "table": None,
+                        "lock": threading.Lock()}
+
+                def mat(_cell=cell):
+                    with _cell["lock"]:
+                        if _cell["table"] is None:
+                            _cell["table"] = to_host(_cell["dt"])
+                            _cell["dt"] = None
+                    return _cell["table"]
+                cells[ri] = (cell, mat)
+            return cells[ri]
+
+        for t, ri, wait in zip(members, member_rows, waits):
+            _metrics.SERVICE_QUEUE_WAIT_MS.inc(wait)
+            stats = ExecStats(mode="batched", device_ms=device_ms,
+                              queue_wait_ms=wait,
+                              batched_with=len(members) - 1)
+            cell, mat = shared_cell(ri)
+            self._finish_ticket(t, result=cell, stats=stats,
+                                materialize=lambda _c, _m=mat: _m(_c))
+        with session._sql_lock:
+            # the shared observability view mirrors direct sql() behavior:
+            # last_exec_stats describes the most recent completed dispatch
+            last = ExecStats(mode="batched", device_ms=device_ms,
+                             queue_wait_ms=waits[-1],
+                             batched_with=len(members) - 1)
+            session._finish_exec_stats(last)
+        return True
+
+    def _serve_serial(self, ticket: Ticket) -> None:
+        """The normal Session path (record/adopt/replay, streaming,
+        segmentation, host fallback) with the service's pre-built plan —
+        result + per-query stats captured atomically."""
+        wait = ticket.mark_started()
+        _metrics.SERVICE_QUEUE_WAIT_MS.inc(wait)
+        try:
+            with TRACER.span("service.exec", label=ticket.label):
+                table, stats = self.session.service_run(
+                    ticket.query, backend=ticket.backend,
+                    label=ticket.label, plan=ticket.plan)
+        except Exception as e:
+            self._finish_ticket(ticket, error=e)
+            return
+        if stats is None:
+            stats = ExecStats(mode="host")
+        stats.queue_wait_ms = wait
+        self._finish_ticket(ticket, result=table, stats=stats)
+
+    # -- shared bookkeeping --------------------------------------------------
+    def _expire_if_late(self, ticket: Ticket, where: str) -> bool:
+        if not ticket.deadline.expired():
+            return False
+        _metrics.SERVICE_DEADLINE_EXPIRED.inc()
+        self._finish_ticket(ticket, error=DeadlineExceeded(
+            f"query {ticket.label!r} ({ticket.tenant}) exceeded its "
+            f"{ticket.deadline.seconds}s budget while {where}"))
+        return True
+
+    def _finish_ticket(self, ticket: Ticket, result=None,
+                       stats: Optional[ExecStats] = None,
+                       error: Optional[BaseException] = None,
+                       materialize=None) -> None:
+        if error is not None:
+            ticket.fail(error)
+        else:
+            ticket.finish(result, stats, materialize=materialize)
+        with self._cv:
+            self._pending -= 1
+            _metrics.SERVICE_QUEUE_DEPTH.set(self._pending)
+            self._cv.notify_all()

@@ -8,7 +8,12 @@ power run; ``process`` mode launches one OS process per stream (the
 reference's N-concurrent-apps shape — separate interpreters so the
 streams contend only for the device, not the GIL), ``thread`` mode
 multiplexes in-process sessions onto one device (cheap for tests and for
-sharing a single compiled-query cache).
+sharing a single compiled-query cache), and ``service`` mode submits
+EVERY stream's queries through one shared admission-controlled
+QueryService over a single Session (nds_tpu/service): one warehouse
+registration, one cross-client program cache, compatible queries from
+different streams coalescing into batched dispatches — the interactive
+multi-tenant shape, measured with the same per-stream time logs.
 
 On top of the reference's detect-and-abort posture sits a supervisor
 (resilience layer): each stream gets a wall-clock budget and up to N spawn
@@ -72,6 +77,44 @@ def _run_stream_thread(input_prefix: str, stream_file: str, time_log: str,
                        **kwargs) -> None:
     from .power import run_query_stream
     run_query_stream(input_prefix, stream_file, time_log, **kwargs)
+
+
+def _run_stream_service(service, stream_file: str, time_log: str,
+                        sub_queries: list[str] | None = None,
+                        warmup: int = 0,
+                        backend: str | None = None) -> None:
+    """One stream's queries through a shared QueryService: same time-log
+    contract as a power run (per-query rows + Power Start/End sentinels),
+    but execution interleaves with every other stream on one session —
+    queries wait in the service queue instead of contending for the GIL
+    at full-plan granularity, and compatible templates across streams
+    batch into shared dispatches."""
+    import re as _re
+    import time as _time
+
+    from .power import _write_time_log, gen_sql_from_stream
+
+    with open(stream_file) as f:
+        query_dict = gen_sql_from_stream(f.read())
+    if sub_queries:
+        query_dict = {
+            k: v for k, v in query_dict.items()
+            if k in sub_queries
+            or _re.sub(r"_part[12]$", "", k) in sub_queries}
+    rows: list[tuple[str, int, int, int]] = []
+    power_start = int(_time.time() * 1000)
+    for name, sql in query_dict.items():
+        statements = [s for s in sql.split(";") if s.strip()]
+        for _ in range(warmup):
+            for stmt in statements:
+                service.sql(stmt, label=name, backend=backend)
+        q_start = int(_time.time() * 1000)
+        for stmt in statements:
+            service.sql(stmt, label=name, backend=backend)
+        q_end = int(_time.time() * 1000)
+        rows.append((name, q_start, q_end, q_end - q_start))
+        _write_time_log(time_log, power_start, rows, None)
+    _write_time_log(time_log, power_start, rows, int(_time.time() * 1000))
 
 
 def _stream_cmd(input_prefix: str, stream_file: str, time_log: str,
@@ -239,6 +282,12 @@ def run_throughput(input_prefix: str, stream_dir: str, streams: list[int],
     Elapsed is max(stream Power End) - min(stream Power Start) over the
     written time logs, the reference's definition (nds_bench.py:138-157).
 
+    mode "service" multiplexes every stream through ONE shared
+    admission-controlled QueryService over a single Session (shared
+    program cache + compatible-plan batching across streams); per-stream
+    time logs keep the same contract, but ``output_prefix`` (per-query
+    parquet dumps) is not supported there.
+
     Streams run SUPERVISED: each gets ``max_attempts`` spawns (default
     EngineConfig.stream_attempts) and a ``stream_timeout`` wall budget
     (default EngineConfig.stream_timeout_s; 0 = none). A crashed or
@@ -278,6 +327,36 @@ def run_throughput(input_prefix: str, stream_dir: str, streams: list[int],
         statuses = supervise_processes(proc_jobs, max_attempts=max_attempts,
                                        stream_timeout=stream_timeout,
                                        backoff_s=retry_backoff_s)
+    elif mode == "service":
+        # in-process multi-tenant mode: ONE session + warehouse
+        # registration + program cache serves every stream through the
+        # admission-controlled service; streams are client threads
+        from .config import apply_decimal, maybe_enable_compile_cache
+        from .engine import Session
+        from .service import QueryService, ServiceConfig
+
+        maybe_enable_compile_cache()
+        apply_decimal(config, decimal)
+        session = Session(config)
+        from .power import setup_tables
+        setup_tables(session, input_prefix, input_format)
+        svc_cfg = ServiceConfig(
+            max_pending=max(256, 8 * len(jobs)),
+            tenant_deadlines={}, default_deadline_s=0.0)
+        with QueryService(session, svc_cfg) as service:
+            def make_run(sf, log, out):
+                def run():
+                    _run_stream_service(service, sf, log,
+                                        sub_queries=sub_queries,
+                                        warmup=warmup, backend=backend)
+                return run
+
+            with ThreadPoolExecutor(max_workers=len(jobs)) as pool:
+                futures = [pool.submit(_supervised_thread_stream, s,
+                                       make_run(sf, log, out), max_attempts,
+                                       stream_timeout, retry_backoff_s)
+                           for s, sf, log, out in jobs]
+                statuses = [f.result() for f in futures]
     else:
         def make_run(sf, log, out):
             def run():
@@ -381,7 +460,11 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--property_file", default=None)
     p.add_argument("--backend", default=None, choices=["jax", "numpy"])
     p.add_argument("--mode", default="process",
-                   choices=["process", "thread"])
+                   choices=["process", "thread", "service"],
+                   help="process = one OS process per stream (reference "
+                        "shape); thread = in-process sessions; service = "
+                        "all streams through one shared admission-"
+                        "controlled QueryService (nds_tpu/service)")
     p.add_argument("--warmup", type=int, default=0,
                    help="untimed pre-runs per query in each stream")
     p.add_argument("--decimal", default=None, choices=["f64", "i64"])

@@ -24,8 +24,14 @@ ENG002 — cross-thread writes take the lock. Functions handed to worker
   threads (threading.Thread(target=...), pool.submit/map) run concurrently
   with the session; an attribute write to shared state from such a function
   races unless it happens under a lock (the race class PR 2's per-program
-  lock fixed by hand in CompiledQuery). The rule flags attribute writes
-  inside thread-target functions (and their nested closures) unless:
+  lock fixed by hand in CompiledQuery). Functions that are ENTERED
+  concurrently without being a literal thread target — the session entry
+  points the query service's client threads and planner workers call
+  (Session.sql, column_stats, column_enc_stats, load_table) — opt into the
+  same rule with a def-line pragma  `# lint: thread-entry (<reason>)`,
+  so the lint (not review) enforces their locking discipline. The rule
+  flags attribute writes inside thread-target/thread-entry functions (and
+  their nested closures) unless:
     - lexically inside a `with <...lock...>:` block (any context-manager
       expression whose dotted name ends in "lock", e.g. `self._lock`,
       `_SHARED_LOCK` — the declared lock-protected set);
@@ -73,6 +79,10 @@ MUTATOR_METHODS = frozenset({
 
 _FROZEN_EXEMPT = re.compile(r"#\s*lint:\s*frozen-exempt")
 _LOCK_EXEMPT = re.compile(r"#\s*lint:\s*lock-exempt")
+#: def-line pragma declaring a function concurrently entered (service
+#: client threads / planner workers) — ENG002 applies as if it were a
+#: thread target, so its shared-state writes must sit under a lock
+_THREAD_ENTRY = re.compile(r"#\s*lint:\s*thread-entry")
 
 
 @dataclass
@@ -191,8 +201,17 @@ class _Linter(ast.NodeVisitor):
         self.generic_visit(node)
         self._class_stack.pop()
 
+    def _thread_entry_pragma(self, node) -> bool:
+        """Does the def (header lines, up to the first body statement)
+        carry the `# lint: thread-entry` pragma?"""
+        end = node.body[0].lineno if node.body else node.lineno
+        return any(_THREAD_ENTRY.search(self.lines[ln - 1])
+                   for ln in range(node.lineno, min(end, len(self.lines)) + 1)
+                   if 1 <= ln <= len(self.lines))
+
     def _visit_fn(self, node) -> None:
-        entered_thread = node.name in self.thread_targets
+        entered_thread = node.name in self.thread_targets \
+            or self._thread_entry_pragma(node)
         self._fn_stack.append(_FunctionInfo(node))
         if entered_thread:
             self._thread_depth += 1

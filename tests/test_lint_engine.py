@@ -160,6 +160,40 @@ def test_lock_exempt_pragma():
     assert out == []
 
 
+def test_thread_entry_pragma_applies_eng002():
+    """Functions entered concurrently WITHOUT being a literal thread
+    target (Session.sql / column_stats under the query service) opt into
+    ENG002 with the def-line thread-entry pragma: an unlocked cache write
+    inside is flagged, the same write under the lock is not."""
+    out = _findings("""
+        class Session:
+            def column_stats(self, name):  # lint: thread-entry (service)
+                self._col_stats[name] = {}
+                return self._col_stats[name]
+    """)
+    assert [f.rule for f in out] == ["ENG002"]
+    assert "_col_stats" in out[0].message
+
+    out = _findings("""
+        class Session:
+            def column_stats(self, name):  # lint: thread-entry (service)
+                with self._lock:
+                    self._col_stats[name] = {}
+                return self._col_stats[name]
+    """)
+    assert out == []
+
+
+def test_thread_entry_pragma_on_multiline_def():
+    out = _findings("""
+        class Session:
+            def sql(self, query,
+                    backend=None):  # lint: thread-entry (service clients)
+                self.last = query
+    """)
+    assert [f.rule for f in out] == ["ENG002"]
+
+
 # -- the CI gate: the real tree is clean ------------------------------------
 
 def test_nds_tpu_tree_is_clean():

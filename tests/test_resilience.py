@@ -312,14 +312,18 @@ def test_power_resume_skips_completed_queries(tiny_env, tmp_path):
 
 def test_power_deadline_kills_hung_execute(tiny_env, tmp_path):
     """A hung jax.execute fault point is killed by the per-query deadline
-    and recorded as Failed; the stream keeps going."""
+    and recorded as Failed; the stream keeps going (the abandoned worker
+    cannot block it — power swaps the session's statement lock via
+    abandon_inflight). Budget 2 s: well under the 3 s hang so the kill
+    still fires, well over query3's ~0.4 s cold record pass so the
+    neighbor's completion is not a timing race on a loaded 1-core host."""
     inp, streams, _ = tiny_env
     FAULTS.arm("jax.execute:hang:3#1")
     json_dir = str(tmp_path / "json")
     t0 = time.monotonic()
     rows = run_query_stream(inp, os.path.join(streams, "query_0.sql"),
                             str(tmp_path / "t.csv"), backend="jax",
-                            json_summary_folder=json_dir, query_timeout=0.5)
+                            json_summary_folder=json_dir, query_timeout=2.0)
     assert [r[0] for r in rows] == ["query1", "query3"]
     assert time.monotonic() - t0 < 60
     summaries = {}
@@ -329,6 +333,32 @@ def test_power_deadline_kills_hung_execute(tiny_env, tmp_path):
     assert summaries["query1"]["queryStatus"] == ["Failed"]
     assert any("exceeded" in e and "budget" in e
                for e in summaries["query1"]["exceptions"])
+    assert summaries["query3"]["queryStatus"][0] in (
+        "Completed", "CompletedWithTaskFailures")
+
+
+def test_deadline_abandoned_worker_does_not_block_stream(tiny_env,
+                                                         tmp_path):
+    """The abandoned worker cannot be killed and sits INSIDE sql() —
+    holding the session's statement serialization lock — for its whole
+    12 s hang. power swaps in fresh locks after the deadline fires
+    (Session.abandon_inflight), so the next query must run immediately
+    and COMPLETE instead of queueing behind the zombie until its own
+    budget expires."""
+    inp, streams, _ = tiny_env
+    FAULTS.arm("jax.execute:hang:12#1")
+    json_dir = str(tmp_path / "json")
+    t0 = time.monotonic()
+    rows = run_query_stream(inp, os.path.join(streams, "query_0.sql"),
+                            str(tmp_path / "t.csv"), backend="jax",
+                            json_summary_folder=json_dir, query_timeout=2.0)
+    assert [r[0] for r in rows] == ["query1", "query3"]
+    assert time.monotonic() - t0 < 10   # nobody waited out the 12 s hang
+    summaries = {}
+    for path in glob.glob(os.path.join(json_dir, "*.json")):
+        with open(path) as f:
+            summaries[os.path.basename(path).split("-")[1]] = json.load(f)
+    assert summaries["query1"]["queryStatus"] == ["Failed"]
     assert summaries["query3"]["queryStatus"][0] in (
         "Completed", "CompletedWithTaskFailures")
 

@@ -286,6 +286,11 @@ def main(argv=None) -> None:
             run_s = time.perf_counter() - t0
             wall_s += run_s
             best = min(best, run_s)
+            # per-template latency distribution: the same histogram family
+            # the service records into, so one registry view ranks slow
+            # templates across bench, power, and service runs
+            METRICS.histogram("query_latency_ms",
+                              template=name).observe(run_s * 1000.0)
         jax_ms[name] = best * 1000
         # fraction of the timed window the per-program device-time
         # attribution explains (>=0.9 expected: everything outside
@@ -345,7 +350,7 @@ def main(argv=None) -> None:
         bw_gbps=bw_gbps, top=15 + (8 * len(mesh_counts) if mesh_counts
                                    else 0))
     out = {
-        "schema_version": 2,
+        "schema_version": 3,
         "metric": f"nds_power_{qtag}_sf{SCALE}_ms",
         "value": round(total_jax, 1),
         "unit": "ms",
@@ -380,6 +385,9 @@ def main(argv=None) -> None:
         # uniform engine counters (obs.metrics): every layer writes through
         # one registry, every report reads the same names
         "metrics": METRICS.snapshot(),
+        # histogram snapshots (count/sum/min/max + sparse log buckets):
+        # scripts/obs_report.py renders quantile tables from this block
+        "histograms": METRICS.histograms(),
     }
     if mesh_scaling is not None:
         # per-shard-count scaling of the same slice (sharded morsel

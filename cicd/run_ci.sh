@@ -48,9 +48,21 @@
 #                batched-dispatch bit-identity vs serial, cross-client
 #                program adoption with flat compile counts, concurrent-
 #                client and live-config-toggle races, service-backed
-#                throughput streams (tests/test_service.py); the
-#                100-client open-loop run carries the slow marker and
-#                runs in the full `test` stage
+#                throughput streams (tests/test_service.py); plus the
+#                service-grade observability suite (tests/
+#                test_obs_service.py): histogram quantile-error/merge
+#                properties, span parent-linkage across the service's
+#                thread hops, flight-recorder ring overflow and fault-
+#                triggered dumps; the 100-client open-loop run carries
+#                the slow marker and runs in the full `test` stage
+#   metrics_gate - diff the deterministic gate workload's COUNT-shaped
+#                engine counters (compiles, cache hits, morsels, batch
+#                sizes...) against cicd/metrics_baseline.json with
+#                generous ratio bounds; wall-time metrics are report-
+#                only (this host's timing flakes). Catches cache-key /
+#                batching / re-trace regressions every bit-identity test
+#                is blind to (scripts/metrics_gate.py --update refreshes
+#                the baseline after intentional behavior changes)
 #   test       - full pytest suite on an 8-virtual-device CPU mesh
 #   bench      - quick bench slice (SF 0.01) to catch perf regressions early
 #   all        - every stage in order
@@ -131,9 +143,18 @@ stage_service() {
     # concurrent query service: every response a client receives must be
     # bit-identical to a fresh single-caller session running the same SQL
     # — through batched dispatches, the serial lane, deadline-expired
-    # neighbors, and live config toggles
+    # neighbors, and live config toggles; the service-observability suite
+    # (histograms, trace propagation, flight recorder) gates here because
+    # its hooks thread through the same service stages
     (cd "$REPO" && python -m pytest tests/test_service.py \
-        -q -m 'not slow')
+        tests/test_obs_service.py -q -m 'not slow')
+}
+
+stage_metrics_gate() {
+    # count-shaped counter diff vs the checked-in baseline: compiles,
+    # cache hits, morsel/batch counts must stay in band on the fixed
+    # workload (wall-time metrics report-only — CI hosts flake)
+    (cd "$REPO" && python scripts/metrics_gate.py)
 }
 
 stage_test() {
@@ -161,16 +182,16 @@ run_stage() {
 }
 
 case "${1:-all}" in
-    native|resilience|static|planner|encoded|kernels|mesh|service|test|bench)
+    native|resilience|static|planner|encoded|kernels|mesh|service|metrics_gate|test|bench)
         run_stage "$1" ;;
     all)
         total0=$SECONDS
         for s in native resilience static planner encoded kernels mesh \
-                 service test bench; do
+                 service metrics_gate test bench; do
             run_stage "$s"
         done
         echo "stage all: $((SECONDS - total0))s" ;;
-    --list)     echo "native resilience static planner encoded kernels mesh service test bench all" ;;
-    *) echo "usage: run_ci.sh [native|resilience|static|planner|encoded|kernels|mesh|service|test|bench|all|--list]" >&2
+    --list)     echo "native resilience static planner encoded kernels mesh service metrics_gate test bench all" ;;
+    *) echo "usage: run_ci.sh [native|resilience|static|planner|encoded|kernels|mesh|service|metrics_gate|test|bench|all|--list]" >&2
        exit 2 ;;
 esac

@@ -1055,7 +1055,9 @@ class JaxExecutor:
             bq = BatchedQuery(cq, cap)
             self._batched[(fp, cap)] = bq
         self.fallback_nodes = []
-        self.last_stats = {}
+        # batch-shape observability: the service's dispatch spans and
+        # ExecStats extras report how the stacked matrix actually looked
+        self.last_stats = {"batch_rows": len(rows), "batch_cap": cap}
         return bq.run(self._scans_for({"scan_keys": cq.scan_keys}), rows,
                       stats=self.last_stats)
 

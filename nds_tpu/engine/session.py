@@ -651,6 +651,11 @@ class Session:
             _metrics.MORSELS.inc(stats.morsels)
         if stats.bytes_uploaded:
             _metrics.BYTES_UPLOADED.inc(stats.bytes_uploaded)
+        if stats.host_decode_ms:
+            # the staging-thread wall, registry-visible per process (the
+            # per-table split stays in the stats record)
+            _metrics.HOST_DECODE_MS.inc(
+                round(sum(stats.host_decode_ms.values()), 3))
 
     def _stream_config_key(self) -> tuple:
         """Streaming-state cache validity fingerprint: the cached rewritten

@@ -7,8 +7,13 @@ engine could not say which operator in which query burns the chip's time
 - :mod:`.trace`   — lifecycle span tracer (parse -> plan passes ->
   compile -> upload -> per-morsel exec -> finalize) with Chrome-trace /
   JSONL / aggregate exporters; near-zero cost disabled.
-- :mod:`.metrics` — process-wide typed counter/gauge registry every layer
-  writes through; snapshots embed in bench/power JSON.
+- :mod:`.metrics` — process-wide typed counter/gauge/histogram registry
+  every layer writes through (one shared value lock per registry: every
+  snapshot is an atomic cut); histograms carry {tenant, template} labels
+  so per-tenant p50/p95/p99 read live; Prometheus/JSON exporters.
+- :mod:`.flight`  — bounded ring of query-lifecycle events, JSONL-dumped
+  on demand, on rejection storms, or when a fault point fires (the
+  post-mortem artifact chaos runs assert against).
 - :mod:`.device_time` — per-compiled-program measured device time +
   cost_analysis FLOPs/bytes, ranked with per-program roofline fractions.
 - :mod:`.stats`   — the typed ``ExecStats`` replacing the untyped
@@ -18,6 +23,7 @@ engine could not say which operator in which query burns the chip's time
 """
 from .trace import TRACER, span                                  # noqa: F401
 from .metrics import METRICS                                     # noqa: F401
+from .flight import FLIGHT                                       # noqa: F401
 from .device_time import PROGRAMS                                # noqa: F401
 from .stats import ExecStats                                     # noqa: F401
 from .log import get_logger                                      # noqa: F401

@@ -1,0 +1,170 @@
+"""Flight recorder: a bounded ring of query-lifecycle events.
+
+Metrics answer "how much"; traces answer "where did the time go" for runs
+you thought to trace. The flight recorder answers the post-mortem
+question — *what was the service doing right before it went wrong* —
+without requiring anything to be enabled ahead of the failure window
+being interesting: it is cheap enough to leave on for whole service runs
+(a dict append into a fixed-size ring), keeps only the most recent
+``capacity`` events, and dumps itself to JSONL when something trips it:
+
+- **explicitly** (``FLIGHT.dump_jsonl(path)`` / ``scripts/obs_report.py``),
+- **on a typed-rejection storm** — ``reject_storm`` rejections inside
+  ``reject_window_s`` seconds auto-dump once per cooldown, so the record
+  of the overload's onset survives the overload;
+- **when a FaultRegistry point fires** — chaos runs (ROADMAP item 5) arm
+  ``device.put``/``jax.compile``/... specs mid-service and assert against
+  the dumped artifact: the ring holds the admissions, dispatches, and
+  batch compositions that surrounded the injected failure.
+
+Events are flat dicts: ``seq`` (total-order sequence number), ``t_ms``
+(monotonic ms since recorder start — immune to wall-clock steps), an
+``event`` tag (admit / plan / dispatch / batch / retry / fault / reject /
+expire / complete / error / trip), and whatever fields the recording site
+attaches (label, tenant, template, latency_ms, ...).
+
+Disabled (the default outside the service) a record() is one attribute
+read — the same near-zero contract as the span tracer. Enable with
+``FLIGHT.configure(enabled=True, dump_dir=...)``, ``NDS_TPU_FLIGHT=1``
+(+ ``NDS_TPU_FLIGHT_DIR``), or ``QueryService`` knobs.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+
+class FlightRecorder:
+    """Process-wide lifecycle-event ring (one instance: ``FLIGHT``)."""
+
+    def __init__(self, capacity: int = 4096):
+        self.enabled = False
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=capacity)
+        self._seq = 0
+        self._epoch = time.monotonic()
+        self.dump_dir: Optional[str] = None
+        #: reject-storm trip wire: N rejects inside the window auto-dump
+        self.reject_storm = 50
+        self.reject_window_s = 10.0
+        self._rejects: deque = deque()
+        #: per-reason cooldown so a sustained storm/fault burst produces
+        #: one artifact per window, not one per event
+        self.trip_cooldown_s = 30.0
+        self._last_trip: dict[str, float] = {}
+        #: paths written by automatic trips (inspection/tests)
+        self.dumps: list[str] = []
+
+    # -- control -------------------------------------------------------------
+    def configure(self, enabled: bool = True,
+                  capacity: Optional[int] = None,
+                  dump_dir: Optional[str] = None,
+                  reject_storm: Optional[int] = None,
+                  reject_window_s: Optional[float] = None,
+                  clear: bool = True) -> "FlightRecorder":
+        with self._lock:
+            if capacity is not None:
+                self._ring = deque(self._ring, maxlen=capacity)
+            if dump_dir is not None:
+                self.dump_dir = dump_dir
+            if reject_storm is not None:
+                self.reject_storm = reject_storm
+            if reject_window_s is not None:
+                self.reject_window_s = reject_window_s
+            if clear:
+                self._ring.clear()
+                self._rejects.clear()
+                self._last_trip.clear()
+                self.dumps = []
+                self._seq = 0
+                self._epoch = time.monotonic()
+            self.enabled = enabled
+        return self
+
+    def clear(self) -> None:
+        self.configure(enabled=self.enabled, clear=True)
+
+    # -- recording -----------------------------------------------------------
+    def record(self, event: str, **fields) -> None:
+        """Append one lifecycle event (no-op while disabled). A "reject"
+        event also feeds the storm trip wire."""
+        if not self.enabled:
+            return
+        now = time.monotonic()
+        with self._lock:
+            self._seq += 1
+            e = {"seq": self._seq,
+                 "t_ms": round((now - self._epoch) * 1000.0, 3),
+                 "event": event}
+            e.update(fields)
+            self._ring.append(e)
+            if event != "reject":
+                return
+            self._rejects.append(now)
+            while self._rejects and \
+                    now - self._rejects[0] > self.reject_window_s:
+                self._rejects.popleft()
+            storm = len(self._rejects) >= self.reject_storm
+            count = len(self._rejects)
+            if storm:
+                # one trip per storm: the next trip needs a fresh window
+                # of rejections (the dump cooldown additionally bounds
+                # artifact volume under sustained overload)
+                self._rejects.clear()
+        if storm:
+            self.trip("reject_storm", rejects=count,
+                      window_s=self.reject_window_s)
+
+    def trip(self, reason: str, **fields) -> Optional[str]:
+        """Something post-mortem-worthy happened: record a "trip" event
+        and, when a dump_dir is configured, write the ring to a JSONL
+        artifact (rate-limited per reason by trip_cooldown_s). Returns
+        the written path, or None when rate-limited / not dumping."""
+        if not self.enabled:
+            return None
+        now = time.monotonic()
+        with self._lock:
+            last = self._last_trip.get(reason)
+            limited = last is not None and \
+                now - last < self.trip_cooldown_s
+            if not limited:
+                self._last_trip[reason] = now
+        self.record("trip", reason=reason, dumped=not limited, **fields)
+        if limited or not self.dump_dir:
+            return None
+        path = os.path.join(
+            self.dump_dir,
+            f"flight_{reason}_{int(time.time())}_{self._seq}.jsonl")
+        self.dump_jsonl(path)
+        with self._lock:
+            self.dumps.append(path)
+        return path
+
+    # -- inspection / export -------------------------------------------------
+    def events(self) -> list[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    def dump_jsonl(self, path: str) -> str:
+        """Write the current ring, oldest first, one event per line —
+        the artifact ``scripts/trace_report.py`` / ``obs_report.py``
+        summarize and chaos runs assert against."""
+        events = self.events()
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            for e in events:
+                f.write(json.dumps(e) + "\n")
+        return path
+
+
+#: the process-global recorder every lifecycle hook reports into.
+FLIGHT = FlightRecorder()
+
+if os.environ.get("NDS_TPU_FLIGHT", "").lower() in ("1", "true", "yes",
+                                                    "on"):
+    FLIGHT.configure(enabled=True,
+                     dump_dir=os.environ.get("NDS_TPU_FLIGHT_DIR") or ".")

@@ -95,6 +95,10 @@ class ExecStats:
     #: co-served queries: how many OTHER admitted queries rode the same
     #: compiled dispatch (compatible-plan batching); None = not batched
     batched_with: Optional[int] = None
+    #: the query's ``service/ticket`` root span id — joins this stats
+    #: record to its span subtree in a Chrome-trace/JSONL export (None
+    #: outside the service, 0 when tracing was disabled at submit)
+    trace_id: Optional[int] = None
     # -- failure observability -----------------------------------------------
     fallback_reasons: list = field(default_factory=list)
     #: EVERY staging-thread failure of the run ("Type: message"), not just
@@ -173,7 +177,7 @@ class ExecStats:
                   "host_decode_ms", "mesh_shards", "sharded_groups",
                   "collective_bytes", "collective_ms",
                   "pallas_ops", "pallas_fallback_reason",
-                  "queue_wait_ms", "batched_with"):
+                  "queue_wait_ms", "batched_with", "trace_id"):
             v = getattr(self, k)
             if v is not None:
                 out[k] = v

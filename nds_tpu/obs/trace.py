@@ -41,6 +41,7 @@ from typing import Optional
 class _NullSpan:
     """Shared do-nothing span returned while tracing is disabled."""
     __slots__ = ()
+    sid = 0     # detached-span protocol: a disabled span has no identity
 
     def __enter__(self) -> "_NullSpan":
         return self
@@ -50,6 +51,12 @@ class _NullSpan:
 
     def set(self, **attrs) -> "_NullSpan":
         return self
+
+    def begin(self) -> "_NullSpan":
+        return self
+
+    def end(self, error: Optional[str] = None) -> None:
+        return None
 
 
 NULL_SPAN = _NullSpan()
@@ -61,11 +68,19 @@ class Span:
     Event layout is the Chrome trace-event "complete" form (ph="X", ts/dur
     in microseconds) extended with ``sid``/``parent`` so the span tree is
     reconstructible from the flat event list (Perfetto ignores the extra
-    keys)."""
-    __slots__ = ("name", "cat", "attrs", "sid", "parent", "tid", "_t0",
-                 "_tracer")
+    keys).
 
-    def __init__(self, tracer: "Tracer", name: str, cat: str, attrs: dict):
+    Two lifetimes: the context-manager form nests via the thread-local
+    parent stack (same-thread children), and the DETACHED form
+    (``begin()``/``end()``) lives across thread hops — a service ticket's
+    root span opens on the client thread at admission and closes on the
+    device lane at completion, with every stage span parent-linked to it
+    through the explicit ``parent=`` override."""
+    __slots__ = ("name", "cat", "attrs", "sid", "parent", "tid", "_t0",
+                 "_tracer", "_parent_override", "_detached")
+
+    def __init__(self, tracer: "Tracer", name: str, cat: str, attrs: dict,
+                 parent: Optional[int] = None):
         self._tracer = tracer
         self.name = name
         self.cat = cat
@@ -74,32 +89,50 @@ class Span:
         self.parent = 0
         self.tid = 0
         self._t0 = 0.0
+        self._parent_override = parent
+        self._detached = False
 
     def set(self, **attrs) -> "Span":
         """Attach attributes discovered mid-span (rows, bytes, mode...)."""
         self.attrs.update(attrs)
         return self
 
-    def __enter__(self) -> "Span":
+    def _open(self) -> None:
         tr = self._tracer
         self.sid = next(tr._ids)
         self.tid = threading.get_ident()
-        stack = tr._stack()
-        self.parent = stack[-1] if stack else 0
-        stack.append(self.sid)
         with tr._lock:
             tr._open[self.sid] = self
         self._t0 = time.perf_counter()
-        return self
 
-    def __exit__(self, exc_type, exc, tb) -> bool:
-        t1 = time.perf_counter()
+    def __enter__(self) -> "Span":
         tr = self._tracer
         stack = tr._stack()
-        if stack and stack[-1] == self.sid:
-            stack.pop()
-        if exc_type is not None:
-            self.attrs["error"] = exc_type.__name__
+        self.parent = self._parent_override if self._parent_override \
+            is not None else (stack[-1] if stack else 0)
+        self._open()
+        stack.append(self.sid)
+        return self
+
+    def begin(self) -> "Span":
+        """Open DETACHED: not pushed on any thread's parent stack, so it
+        may be closed (``end()``) from a different thread. Parent comes
+        only from the explicit ``parent=`` override (0 = root)."""
+        self._detached = True
+        self.parent = self._parent_override or 0
+        self._open()
+        return self
+
+    def end(self, error: Optional[str] = None) -> None:
+        """Close a detached span (thread-agnostic counterpart of
+        ``__exit__``)."""
+        self._close(error)
+
+    def _close(self, error: Optional[str]) -> None:
+        t1 = time.perf_counter()
+        tr = self._tracer
+        if error is not None:
+            self.attrs["error"] = error
         event = {
             "name": self.name, "cat": self.cat, "ph": "X",
             "ts": round((self._t0 - tr._epoch) * 1e6, 1),
@@ -112,6 +145,12 @@ class Span:
         with tr._lock:
             tr._open.pop(self.sid, None)
             tr._events.append(event)
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        stack = self._tracer._stack()
+        if stack and stack[-1] == self.sid:
+            stack.pop()
+        self._close(exc_type.__name__ if exc_type is not None else None)
         return False
 
 
@@ -128,12 +167,20 @@ class Tracer:
         self._epoch = time.perf_counter()
 
     # -- recording -----------------------------------------------------------
-    def span(self, name: str, cat: str = "engine", **attrs):
+    def span(self, name: str, cat: str = "engine",
+             parent: Optional[int] = None, **attrs):
         """Open a span; use as a context manager. The ONLY hook call sites
-        need — a plain no-op while disabled."""
+        need — a plain no-op while disabled.
+
+        ``parent``: explicit parent span id, overriding the thread-local
+        stack — how the query service parent-links a ticket's stage spans
+        (planner thread, device lane, client materialization) back to the
+        ``service/ticket`` root opened on the submitting thread. Use
+        ``.begin()``/``.end()`` instead of ``with`` for a span that opens
+        and closes on different threads."""
         if not self.enabled:
             return NULL_SPAN
-        return Span(self, name, cat, attrs)
+        return Span(self, name, cat, attrs, parent=parent)
 
     def instant(self, name: str, cat: str = "engine", **attrs) -> None:
         """Record a zero-duration marker event (ph="i")."""

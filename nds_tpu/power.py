@@ -388,6 +388,10 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
             # bytes uploaded... — obs.metrics glossary)
             report.record_metrics(METRICS.delta(metrics_before))
             elapsed = report.summary["queryTimes"][-1]
+            # same latency family the bench/service record into: top-K
+            # slow templates rank live from the registry across runners
+            METRICS.histogram("query_latency_ms",
+                              template=name).observe(elapsed)
             rows.append((name, q_start, q_start + elapsed, elapsed))
             status = report.finalize_status()
             if status == "Failed":

@@ -361,8 +361,15 @@ class FaultRegistry:
                 s.fired += 1
                 triggered.append(s)
         if triggered:
+            from .obs.flight import FLIGHT
             from .obs.metrics import FAULT_FIRINGS
             FAULT_FIRINGS.inc(len(triggered))
+            # a firing fault point is exactly the post-mortem moment the
+            # flight recorder exists for: record it and auto-dump the
+            # surrounding lifecycle window (no-op while disabled)
+            FLIGHT.record("fault", point=point, detail=detail,
+                          actions=[s.action for s in triggered])
+            FLIGHT.trip("fault", point=point)
         for s in triggered:         # act outside the lock (sleeps)
             where = f"{point} ({detail})" if detail else point
             if s.action == "delay":

@@ -47,9 +47,21 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from ..obs import metrics as _metrics
+from ..obs.flight import FLIGHT
 from ..obs.stats import ExecStats
 from ..obs.trace import TRACER
 from ..resilience import AdmissionRejected, Deadline, DeadlineExceeded
+
+
+def _observe_phase(name: str, ms: float, tenant: str,
+                   template: Optional[str]) -> None:
+    """Record one phase wall into its histogram family: the base series
+    (whole-service view) plus the (tenant, template) child, so per-tenant
+    p50/p95/p99 and top-K slow templates read live from the registry."""
+    _metrics.METRICS.histogram(name).observe(ms)
+    if template:
+        _metrics.METRICS.histogram(name, tenant=tenant,
+                                   template=template).observe(ms)
 
 
 class ServiceClosed(AdmissionRejected):
@@ -94,7 +106,15 @@ class Ticket:
     """One submitted query's handle. The service hands the ticket through
     its stages (admission -> planner worker -> device lane); each stage is
     the ticket's sole owner while it holds it, and ``result()`` is the
-    client-side rendezvous."""
+    client-side rendezvous.
+
+    The ticket is also the trace-context carrier: ``root`` is a detached
+    ``service/ticket`` span opened at admission on the client thread and
+    closed at completion on whichever thread finishes the ticket, and
+    ``trace_id`` (= root span id, 0 when tracing is disabled) joins the
+    ticket's :class:`ExecStats` to its span subtree in an export. Stage
+    spans (queue/plan/lane_wait/dispatch/materialize) parent-link to it
+    across the three thread hops."""
 
     def __init__(self, query: str, label: str, tenant: str,
                  deadline: Deadline, backend: Optional[str]):
@@ -106,8 +126,17 @@ class Ticket:
         self.submitted_at = time.perf_counter()
         #: wall between admission and execution start (ms); lands in stats
         self.queue_wait_ms: Optional[float] = None
-        #: per-query ExecStats (queue_wait_ms/batched_with included)
+        #: per-query ExecStats (queue_wait_ms/batched_with/trace_id incl.)
         self.stats: Optional[ExecStats] = None
+        # trace context (set by the service at admission)
+        self.root = None                    # detached service/ticket span
+        self.trace_id: int = 0
+        self._queue_span = None             # admission -> planner pickup
+        self._wait_span = None              # planned -> execution start
+        #: template identity for SLO labels: the parameterized-plan
+        #: fingerprint when one exists (instantiations of one template
+        #: collapse), else the stable query label
+        self.template: Optional[str] = None
         # planner-stage products
         self.plan = None
         self.fp: Optional[str] = None
@@ -126,12 +155,41 @@ class Ticket:
         self.fp = fp
         self.pvalues = tuple(pvalues)
         self.use_jax = use_jax
+        self.template = fp[:12] if fp else self.label
+
+    def picked_up(self) -> None:
+        """A planner worker took the ticket: the admission-queue span
+        ends here (single-owner handoff, so no lock needed)."""
+        if self._queue_span is not None:
+            self._queue_span.end()
+            self._queue_span = None
+
+    def begin_wait(self) -> None:
+        """Planned; now waiting for the device lane (span ends at
+        mark_started / expiry)."""
+        self._wait_span = TRACER.span(
+            "service/lane_wait", cat="service", parent=self.trace_id,
+            label=self.label).begin()
 
     def mark_started(self) -> float:
         """Execution starts now: record + return the queue wait (ms)."""
+        if self._wait_span is not None:
+            self._wait_span.end()
+            self._wait_span = None
         self.queue_wait_ms = round(
             (time.perf_counter() - self.submitted_at) * 1000.0, 3)
+        _observe_phase("service_queue_wait_ms", self.queue_wait_ms,
+                       self.tenant, self.template)
         return self.queue_wait_ms
+
+    def close_stage_spans(self, error: Optional[str] = None) -> None:
+        """End any stage span still open (expiry/failure can strike while
+        queued or while waiting for the lane)."""
+        for name in ("_queue_span", "_wait_span"):
+            sp = getattr(self, name)
+            if sp is not None:
+                sp.end(error=error)
+                setattr(self, name, None)
 
     def finish(self, result, stats: Optional[ExecStats],
                materialize=None) -> None:
@@ -165,8 +223,15 @@ class Ticket:
             raise self._error
         with self._mat_lock:
             if self._materialize is not None:
-                self._result = self._materialize(self._result)
+                t0 = time.perf_counter()
+                with TRACER.span("service/materialize", cat="service",
+                                 parent=self.trace_id, label=self.label):
+                    self._result = self._materialize(self._result)
                 self._materialize = None
+                _observe_phase(
+                    "service_materialize_ms",
+                    (time.perf_counter() - t0) * 1000.0,
+                    self.tenant, self.template)
         return self._result
 
 
@@ -288,18 +353,36 @@ class QueryService:
         with self._cv:
             if not self._running:
                 _metrics.SERVICE_REJECTED.inc()
+                FLIGHT.record("reject", label=ticket.label, tenant=tenant,
+                              reason="closed")
                 raise ServiceClosed("query service is not running")
             if self._pending >= cfg.max_pending:
                 _metrics.SERVICE_REJECTED.inc()
+                FLIGHT.record("reject", label=ticket.label, tenant=tenant,
+                              reason="queue_full", depth=self._pending,
+                              limit=cfg.max_pending)
                 raise AdmissionRejected(
                     f"admission queue full: {self._pending} pending >= "
                     f"max_pending {cfg.max_pending}",
                     depth=self._pending, limit=cfg.max_pending)
             self._pending += 1
+            depth = self._pending
             _metrics.SERVICE_ADMITTED.inc()
             _metrics.SERVICE_QUEUE_DEPTH.set(self._pending)
+            # the ticket's trace context: a detached root span the three
+            # downstream thread hops (planner worker, device lane, client
+            # materialization) parent-link their stage spans to
+            ticket.root = TRACER.span("service/ticket", cat="service",
+                                      label=ticket.label,
+                                      tenant=tenant).begin()
+            ticket.trace_id = ticket.root.sid
+            ticket._queue_span = TRACER.span(
+                "service/queue", cat="service", parent=ticket.trace_id,
+                label=ticket.label).begin()
             self._intake.append(ticket)
             self._cv.notify_all()
+        FLIGHT.record("admit", label=ticket.label, tenant=tenant,
+                      depth=depth, trace_id=ticket.trace_id or None)
         return ticket
 
     def sql(self, query: str, label: Optional[str] = None,
@@ -325,14 +408,27 @@ class QueryService:
                 if not self._running:
                     return
                 ticket = self._intake.popleft()
+            ticket.picked_up()
             if self._expire_if_late(ticket, "queued"):
                 continue
+            t0 = time.perf_counter()
             try:
-                with TRACER.span("service.plan", label=ticket.label):
+                # hop 1 (client thread -> planner worker): parent-linked
+                # through the ticket's root span id
+                with TRACER.span("service/plan", cat="service",
+                                 parent=ticket.trace_id,
+                                 label=ticket.label):
                     self._plan_ticket(ticket)
             except Exception as e:
                 self._finish_ticket(ticket, error=e)
                 continue
+            plan_ms = (time.perf_counter() - t0) * 1000.0
+            _observe_phase("service_plan_ms", plan_ms, ticket.tenant,
+                           ticket.template)
+            FLIGHT.record("plan", label=ticket.label, tenant=ticket.tenant,
+                          template=ticket.template,
+                          ms=round(plan_ms, 3), batchable=bool(ticket.fp))
+            ticket.begin_wait()
             with self._cv:
                 self._ready.append(ticket)
                 self._cv.notify_all()
@@ -465,26 +561,54 @@ class QueryService:
                 rows.append(t.pvalues)
             member_rows.append(i)
         waits = [t.mark_started() for t in members]
+        dedup = len(members) - len(rows)
+        # hop 2 (planner worker -> device lane): every member gets its own
+        # dispatch span covering the shared batched dispatch, parent-linked
+        # to ITS ticket root and annotated with the batch composition —
+        # one Chrome-trace export shows who co-rode which dispatch
+        dspans = [TRACER.span("service/dispatch", cat="service",
+                              parent=t.trace_id, label=t.label,
+                              batch_leader=members[0].label,
+                              batched_with=len(members) - 1,
+                              batch_rows=len(rows), dedup=dedup).begin()
+                  for t in members]
+        t0 = time.perf_counter()
         with session._sql_lock:
             jexec = session._jax_executor()
             try:
-                with TRACER.span("service.batch", label=members[0].label,
-                                 queries=len(members), rows=len(rows)):
-                    outs = jexec.run_param_batch(fp, rows)
-            except Exception:
+                outs = jexec.run_param_batch(fp, rows)
+            except Exception as e:
                 # schedule drift (ReplayMismatch), trace failure, transient
                 # runtime error: the serial path both surfaces any genuine
                 # per-query failure and repairs the shared entry
                 outs = None
+                batch_error = type(e).__name__
+            else:
+                batch_error = None if outs is not None else "unavailable"
             if outs is None:
-                for t in members:     # serial path re-measures queue wait
-                    t.queue_wait_ms = None
+                for t, sp in zip(members, dspans):
+                    sp.end(error=batch_error)
+                    t.queue_wait_ms = None   # serial path re-measures
+                FLIGHT.record("retry", label=members[0].label,
+                              queries=len(members), reason=batch_error,
+                              via="serial_fallback")
                 return False
             exec_stats = dict(jexec.last_stats)
+        exec_ms = (time.perf_counter() - t0) * 1000.0
+        for t, sp in zip(members, dspans):
+            sp.end()
+            _observe_phase("service_exec_ms", exec_ms, t.tenant, t.template)
         device_ms = exec_stats.get("device_ms")
-        _metrics.SERVICE_BATCHES.inc()
-        _metrics.SERVICE_BATCHED_QUERIES.inc(len(members))
-        _metrics.QUERIES_RUN.inc(len(members))
+        with _metrics.METRICS.locked():
+            # one logical event, three counters: the shared value lock
+            # keeps any concurrent snapshot from seeing a batch counted
+            # without its member queries (consistent bench deltas)
+            _metrics.SERVICE_BATCHES.inc()
+            _metrics.SERVICE_BATCHED_QUERIES.inc(len(members))
+            _metrics.QUERIES_RUN.inc(len(members))
+        FLIGHT.record("batch", leader=members[0].label,
+                      queries=len(members), rows=len(rows), dedup=dedup,
+                      ms=round(exec_ms, 3))
         cells: dict[int, tuple] = {}
 
         def shared_cell(ri):
@@ -509,7 +633,8 @@ class QueryService:
             _metrics.SERVICE_QUEUE_WAIT_MS.inc(wait)
             stats = ExecStats(mode="batched", device_ms=device_ms,
                               queue_wait_ms=wait,
-                              batched_with=len(members) - 1)
+                              batched_with=len(members) - 1,
+                              trace_id=t.trace_id or None)
             cell, mat = shared_cell(ri)
             self._finish_ticket(t, result=cell, stats=stats,
                                 materialize=lambda _c, _m=mat: _m(_c))
@@ -528,17 +653,26 @@ class QueryService:
         result + per-query stats captured atomically."""
         wait = ticket.mark_started()
         _metrics.SERVICE_QUEUE_WAIT_MS.inc(wait)
+        t0 = time.perf_counter()
         try:
-            with TRACER.span("service.exec", label=ticket.label):
+            # hop 2, serial lane: the session's own "query" span tree
+            # nests under this one via the lane thread's span stack, so
+            # the ticket root reaches down to parse/plan/morsel spans
+            with TRACER.span("service/dispatch", cat="service",
+                             parent=ticket.trace_id, label=ticket.label):
                 table, stats = self.session.service_run(
                     ticket.query, backend=ticket.backend,
                     label=ticket.label, plan=ticket.plan)
         except Exception as e:
             self._finish_ticket(ticket, error=e)
             return
+        _observe_phase("service_exec_ms",
+                       (time.perf_counter() - t0) * 1000.0,
+                       ticket.tenant, ticket.template)
         if stats is None:
             stats = ExecStats(mode="host")
         stats.queue_wait_ms = wait
+        stats.trace_id = ticket.trace_id or None
         self._finish_ticket(ticket, result=table, stats=stats)
 
     # -- shared bookkeeping --------------------------------------------------
@@ -546,6 +680,8 @@ class QueryService:
         if not ticket.deadline.expired():
             return False
         _metrics.SERVICE_DEADLINE_EXPIRED.inc()
+        FLIGHT.record("expire", label=ticket.label, tenant=ticket.tenant,
+                      where=where, budget_s=ticket.deadline.seconds)
         self._finish_ticket(ticket, error=DeadlineExceeded(
             f"query {ticket.label!r} ({ticket.tenant}) exceeded its "
             f"{ticket.deadline.seconds}s budget while {where}"))
@@ -555,10 +691,32 @@ class QueryService:
                        stats: Optional[ExecStats] = None,
                        error: Optional[BaseException] = None,
                        materialize=None) -> None:
+        err_name = type(error).__name__ if error is not None else None
+        ticket.close_stage_spans(error=err_name)
+        latency_ms = round(
+            (time.perf_counter() - ticket.submitted_at) * 1000.0, 3)
         if error is not None:
             ticket.fail(error)
+            FLIGHT.record("error", label=ticket.label,
+                          tenant=ticket.tenant, error=err_name,
+                          latency_ms=latency_ms)
         else:
             ticket.finish(result, stats, materialize=materialize)
+            # the SLO distribution: admission -> completion (deferred
+            # client-side materialization is measured separately)
+            _observe_phase("service_latency_ms", latency_ms,
+                           ticket.tenant, ticket.template)
+            FLIGHT.record("complete", label=ticket.label,
+                          tenant=ticket.tenant, template=ticket.template,
+                          latency_ms=latency_ms,
+                          queue_wait_ms=ticket.queue_wait_ms,
+                          batched_with=stats.batched_with
+                          if stats else None,
+                          trace_id=ticket.trace_id or None)
+        if ticket.root is not None:
+            ticket.root.set(latency_ms=latency_ms)
+            ticket.root.end(error=err_name)
+            ticket.root = None
         with self._cv:
             self._pending -= 1
             _metrics.SERVICE_QUEUE_DEPTH.set(self._pending)

@@ -82,7 +82,8 @@ def _run_stream_thread(input_prefix: str, stream_file: str, time_log: str,
 def _run_stream_service(service, stream_file: str, time_log: str,
                         sub_queries: list[str] | None = None,
                         warmup: int = 0,
-                        backend: str | None = None) -> None:
+                        backend: str | None = None,
+                        tenant: str = "default") -> None:
     """One stream's queries through a shared QueryService: same time-log
     contract as a power run (per-query rows + Power Start/End sentinels),
     but execution interleaves with every other stream on one session —
@@ -107,10 +108,11 @@ def _run_stream_service(service, stream_file: str, time_log: str,
         statements = [s for s in sql.split(";") if s.strip()]
         for _ in range(warmup):
             for stmt in statements:
-                service.sql(stmt, label=name, backend=backend)
+                service.sql(stmt, label=name, backend=backend,
+                            tenant=tenant)
         q_start = int(_time.time() * 1000)
         for stmt in statements:
-            service.sql(stmt, label=name, backend=backend)
+            service.sql(stmt, label=name, backend=backend, tenant=tenant)
         q_end = int(_time.time() * 1000)
         rows.append((name, q_start, q_end, q_end - q_start))
         _write_time_log(time_log, power_start, rows, None)
@@ -264,6 +266,30 @@ def _supervised_thread_stream(sid: int, run, max_attempts: int,
     return st
 
 
+def _write_service_obs(time_log_dir: str) -> None:
+    """Service-mode observability artifacts beside the time logs: the
+    per-tenant/per-stream SLO view (service_slo.json — counts, p50/p95/
+    p99 per series, straight from the registry histograms) and, when the
+    flight recorder is on (NDS_TPU_FLIGHT=1), the round's lifecycle ring
+    as flight.jsonl — the post-mortem record a chaos round asserts on."""
+    import json
+
+    from .obs.flight import FLIGHT
+    from .obs.metrics import METRICS
+
+    rows = METRICS.percentiles("service_latency_ms")
+    if rows:
+        path = os.path.join(time_log_dir, "service_slo.json")
+        with open(path, "w") as f:
+            json.dump({"service_latency_ms": rows,
+                       "histograms": {
+                           k: v for k, v in METRICS.histograms().items()
+                           if v["name"].startswith("service_")}}, f,
+                      indent=2)
+    if FLIGHT.enabled and FLIGHT.events():
+        FLIGHT.dump_jsonl(os.path.join(time_log_dir, "flight.jsonl"))
+
+
 def run_throughput(input_prefix: str, stream_dir: str, streams: list[int],
                    time_log_dir: str,
                    input_format: str = "parquet",
@@ -344,19 +370,24 @@ def run_throughput(input_prefix: str, stream_dir: str, streams: list[int],
             max_pending=max(256, 8 * len(jobs)),
             tenant_deadlines={}, default_deadline_s=0.0)
         with QueryService(session, svc_cfg) as service:
-            def make_run(sf, log, out):
+            def make_run(sid, sf, log, out):
                 def run():
+                    # one tenant per stream: the registry's per-tenant
+                    # service_latency_ms series decompose the round
                     _run_stream_service(service, sf, log,
                                         sub_queries=sub_queries,
-                                        warmup=warmup, backend=backend)
+                                        warmup=warmup, backend=backend,
+                                        tenant=f"stream{sid}")
                 return run
 
             with ThreadPoolExecutor(max_workers=len(jobs)) as pool:
                 futures = [pool.submit(_supervised_thread_stream, s,
-                                       make_run(sf, log, out), max_attempts,
+                                       make_run(s, sf, log, out),
+                                       max_attempts,
                                        stream_timeout, retry_backoff_s)
                            for s, sf, log, out in jobs]
                 statuses = [f.result() for f in futures]
+        _write_service_obs(time_log_dir)
     else:
         def make_run(sf, log, out):
             def run():

@@ -1,0 +1,207 @@
+#!/usr/bin/env python
+"""Service-SLO report: histogram quantile tables + flight-recorder view.
+
+``trace_report.py`` summarizes spans and programs; this tool reads the
+DISTRIBUTION side of the observability layer — the histogram snapshots
+(``obs.metrics.Histogram``) that bench / service_bench / power embed
+under a ``histograms`` key, and flight-recorder JSONL dumps
+(``obs.flight``) — and prints the SLO tables an operator reads first:
+
+- per-family quantile tables (count / mean / p50 / p95 / p99 / max ms)
+  with one row per labeled series, slowest p99 first;
+- the per-tenant SLO view of ``service_latency_ms`` and the top-K slow
+  templates (``--family`` / ``--by`` select others);
+- flight-recorder dumps: event-type counts, per-tenant outcomes, and the
+  slowest completed tickets (delegates to trace_report's renderer so the
+  two tools agree).
+
+Artifacts accepted (auto-detected): a bench/service-bench/power JSON
+carrying ``histograms`` (or a raw ``MetricsRegistry.export_json()``
+dump), or a flight-recorder JSONL. ``--prometheus`` re-renders a JSON
+artifact's histograms + counters in Prometheus text exposition format
+(the live-process form of the same text comes from
+``METRICS.export_prometheus()``).
+
+Usage:
+  python scripts/obs_report.py SERVICE_r01.json
+  python scripts/obs_report.py flight_fault_*.jsonl
+  python scripts/obs_report.py bench.json --family query_latency_ms
+  python scripts/obs_report.py bench.json --prometheus > metrics.prom
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from nds_tpu.obs.metrics import quantile_from_snapshot  # noqa: E402
+
+QS = (0.5, 0.95, 0.99)
+
+
+def load(path: str):
+    """(kind, payload): kind is "hists" ({series: snapshot} + metrics) or
+    "flight" (event list)."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        events = [json.loads(ln) for ln in text.splitlines() if ln.strip()]
+        if events and all("event" in e for e in events):
+            return "flight", events
+        raise ValueError(f"{path}: not a JSON artifact or flight JSONL")
+    if isinstance(doc, dict):
+        if "event" in doc and "t_ms" in doc:
+            return "flight", [doc]      # a one-event JSONL dump
+        hists = doc.get("histograms")
+        if hists is None and "runs" in doc:
+            # a service-bench record without embedded snapshots still has
+            # its per-run SLO rows; surface those
+            return "service_runs", doc
+        if hists is not None:
+            return "hists", doc
+    raise ValueError(f"{path}: no 'histograms' key (re-run the producer "
+                     "on this branch, or pass a flight JSONL)")
+
+
+def rows_for_family(hists: dict, family: str) -> list[dict]:
+    rows = []
+    for key, snap in hists.items():
+        if snap.get("name", key) != family:
+            continue
+        row = {"series": key, "labels": snap.get("labels", {}),
+               "count": snap["count"],
+               "mean": snap["sum"] / snap["count"] if snap["count"] else 0,
+               "max": snap.get("max") or 0}
+        for p in QS:
+            q = quantile_from_snapshot(snap, p)
+            row[f"p{int(p * 100)}"] = q if q is not None else 0
+        rows.append(row)
+    rows.sort(key=lambda r: (bool(r["labels"]), -r["p99"]))
+    return rows
+
+
+def print_family(hists: dict, family: str, by: str, top: int) -> None:
+    rows = rows_for_family(hists, family)
+    if not rows:
+        return
+    print(f"\n{family} (count / mean / p50 / p95 / p99 / max ms):")
+    head = (f"{'series':<52} {'count':>7} {'mean':>9} {'p50':>9} "
+            f"{'p95':>9} {'p99':>9} {'max':>9}")
+    print(head)
+    print("-" * len(head))
+    shown = 0
+    for r in rows:
+        if r["labels"] and shown >= top:
+            continue
+        tag = ",".join(f"{k}={v}" for k, v in sorted(r["labels"].items())) \
+            or "(all)"
+        print(f"{tag[:52]:<52} {r['count']:>7} {r['mean']:>9.1f} "
+              f"{r['p50']:>9.1f} {r['p95']:>9.1f} {r['p99']:>9.1f} "
+              f"{r['max']:>9.1f}")
+        shown += bool(r["labels"])
+    if by:
+        # rollup by one label dimension (merge counts; quantiles cannot
+        # merge without the buckets, so roll the bucket lists up)
+        from nds_tpu.obs.metrics import merge_snapshots
+        groups: dict[str, dict] = {}
+        for key, snap in hists.items():
+            if snap.get("name") != family or by not in \
+                    snap.get("labels", {}):
+                continue
+            g = snap["labels"][by]
+            groups[g] = merge_snapshots(groups[g], snap) if g in groups \
+                else dict(snap)
+        if groups:
+            print(f"\n{family} by {by}:")
+            for g, snap in sorted(
+                    groups.items(),
+                    key=lambda kv: -(quantile_from_snapshot(kv[1], 0.99)
+                                     or 0))[:top]:
+                qs = {p: quantile_from_snapshot(snap, p) or 0 for p in QS}
+                print(f"  {g[:24]:<24} n={snap['count']:<7} "
+                      f"p50={qs[0.5]:>8.1f} p95={qs[0.95]:>8.1f} "
+                      f"p99={qs[0.99]:>8.1f}")
+
+
+def print_prometheus(doc: dict) -> None:
+    """Prometheus text exposition of an artifact's metrics + histograms
+    (offline twin of METRICS.export_prometheus())."""
+    for name, v in (doc.get("metrics") or {}).items():
+        print(f"{name}_total {v}")
+    for _key, snap in (doc.get("histograms") or {}).items():
+        base = ",".join(f'{k}="{v}"' for k, v in
+                        sorted(snap.get("labels", {}).items()))
+        sep = "," if base else ""
+        cum = 0
+        for le, n in snap.get("buckets", ()):
+            cum += n
+            letxt = f"{le:.6g}" if le is not None else "+Inf"
+            print(f'{snap["name"]}_bucket{{{base}{sep}le="{letxt}"}} {cum}')
+        lab = f"{{{base}}}" if base else ""
+        print(f"{snap['name']}_sum{lab} {snap['sum']}")
+        print(f"{snap['name']}_count{lab} {snap['count']}")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="obs_report.py", description=(
+        "histogram/SLO + flight-recorder summarizer for NDS-TPU "
+        "observability artifacts"))
+    p.add_argument("artifact", help="JSON with a 'histograms' block "
+                                    "(bench/service_bench/export_json) "
+                                    "or a flight-recorder JSONL dump")
+    p.add_argument("--family", default=None,
+                   help="histogram family to print (default: every "
+                        "family present, service_latency_ms first)")
+    p.add_argument("--by", default="tenant",
+                   help="label dimension for the rollup table "
+                        "(tenant|template; '' disables)")
+    p.add_argument("--top", type=int, default=12,
+                   help="labeled rows / rollup groups per table")
+    p.add_argument("--prometheus", action="store_true",
+                   help="emit the artifact's metrics + histograms in "
+                        "Prometheus text exposition format instead of "
+                        "tables")
+    a = p.parse_args(argv)
+    try:
+        kind, payload = load(a.artifact)
+    except (ValueError, OSError) as e:
+        print(f"obs_report: {e}", file=sys.stderr)
+        return 2
+    if kind == "flight":
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import trace_report
+        trace_report.print_flight(payload, a.top)
+        return 0
+    if kind == "service_runs":
+        for run in payload.get("runs", []):
+            print(f"clients={run.get('clients')}: qps={run.get('qps')} "
+                  f"p50={run.get('p50_ms')} p99={run.get('p99_ms')}")
+            for row in run.get("per_tenant_slo", [])[:a.top]:
+                print(f"  {row.get('tenant'):<12} "
+                      f"template={row.get('template')} "
+                      f"n={row.get('count')} p50={row.get('p50_ms')} "
+                      f"p95={row.get('p95_ms')} p99={row.get('p99_ms')}")
+        return 0
+    hists = payload["histograms"]
+    if a.prometheus:
+        print_prometheus(payload)
+        return 0
+    families = [a.family] if a.family else sorted(
+        {s.get("name", k) for k, s in hists.items()},
+        key=lambda n: (n != "service_latency_ms", n))
+    if not hists:
+        print("no histogram series recorded in this artifact")
+        return 0
+    for fam in families:
+        print_family(hists, fam, a.by, a.top)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,6 +23,15 @@ Phases:
      the record), latency decomposes into queue_wait + execute via
      ExecStats.queue_wait_ms, and batching shows up as batched_with.
 
+Latency percentiles (p50/p99, queue-wait) come from the REGISTRY
+histograms (obs.metrics — the same per-tenant/per-template SLO source a
+live operator reads), cut to the measured window via snapshot diffs; a
+``percentile_check`` block cross-checks them against exact per-ticket
+latencies from the flight recorder within the histogram's documented
+bucket-error bound, and ``per_tenant_slo`` records the slowest tenants.
+``--trace`` exports one Chrome trace per client count showing every
+ticket's parent-linked admission->plan->dispatch->materialize spans.
+
 Writes one JSON record (default SERVICE_r01.json) and prints it to
 stdout. Diagnostics go to stderr.
 
@@ -107,11 +116,65 @@ def result_hash(table) -> str:
         repr(table.to_pylist()).encode()).hexdigest()[:16]
 
 
-def percentile(sorted_vals: list[float], p: float) -> float:
-    if not sorted_vals:
-        return 0.0
-    k = min(len(sorted_vals) - 1, int(round(p * (len(sorted_vals) - 1))))
-    return sorted_vals[k]
+def hist_window(before: dict, after: dict, name: str) -> dict | None:
+    """The measured window's snapshot of one registry histogram series:
+    after minus before (bucket counts are monotonic)."""
+    from nds_tpu.obs.metrics import diff_snapshot
+    if name not in after:
+        return None
+    return diff_snapshot(after[name], before.get(name, {}))
+
+
+def _hq(snap: dict | None, p: float) -> float:
+    """Histogram quantile of a window snapshot, rounded for the record."""
+    from nds_tpu.obs.metrics import quantile_from_snapshot
+    q = quantile_from_snapshot(snap, p) if snap else None
+    return round(q, 2) if q is not None else 0.0
+
+
+def _percentile_check(lat_hist: dict | None, exact_lat: list) -> dict:
+    """The acceptance cross-check: registry-histogram percentiles vs the
+    exact per-ticket service latencies (flight-recorder complete events),
+    with the histogram's DOCUMENTED error bound (a factor of
+    sqrt(BUCKET_RATIO) ≈ 1.123) recorded beside the observed ratios."""
+    from nds_tpu.obs.metrics import (BUCKET_RATIO, exact_quantile,
+                                     quantile_from_snapshot)
+    out = {"bound_factor": round(BUCKET_RATIO ** 0.5, 4),
+           "samples": len(exact_lat)}
+    for p in (0.50, 0.95, 0.99):
+        exact = exact_quantile(exact_lat, p)
+        hist = quantile_from_snapshot(lat_hist, p) if lat_hist else None
+        key = f"p{int(p * 100)}"
+        out[f"exact_{key}_ms"] = round(exact, 2)
+        out[f"hist_{key}_ms"] = round(hist, 2) if hist is not None else None
+        if hist and exact:
+            out[f"{key}_ratio"] = round(hist / exact, 4)
+            out[f"{key}_within_bound"] = \
+                1 / (BUCKET_RATIO ** 0.5) <= hist / exact \
+                <= BUCKET_RATIO ** 0.5
+    return out
+
+
+def _tenant_slo(h_before: dict, h_after: dict, top: int = 8) -> list:
+    """Per-tenant window SLO rows (slowest p99 first): the live-registry
+    per-tenant view the acceptance criterion asks for, cut to the
+    measured window via snapshot diffs."""
+    from nds_tpu.obs.metrics import quantile_from_snapshot
+    rows = []
+    for key, snap in h_after.items():
+        if snap["name"] != "service_latency_ms" or "labels" not in snap:
+            continue
+        win = hist_window(h_before, h_after, key)
+        if not win or not win["count"]:
+            continue
+        rows.append({
+            "tenant": snap["labels"].get("tenant"),
+            "template": snap["labels"].get("template"),
+            "count": win["count"],
+            "p50_ms": _hq(win, 0.50), "p95_ms": _hq(win, 0.95),
+            "p99_ms": _hq(win, 0.99)})
+    rows.sort(key=lambda r: r["p99_ms"], reverse=True)
+    return rows[:top]
 
 
 def make_session(wh_dir: str):
@@ -143,6 +206,7 @@ def run_serial(wh_dir: str, pool, lists, log) -> dict:
     """The baseline the service must beat: same total workload, one query
     at a time on a fresh single-caller Session."""
     from nds_tpu.engine.jax_backend.executor import clear_shared_programs
+    from nds_tpu.obs.metrics import exact_quantile
 
     clear_shared_programs()
     session = make_session(wh_dir)
@@ -164,8 +228,8 @@ def run_serial(wh_dir: str, pool, lists, log) -> dict:
     total = sum(len(x) for x in lists)
     rec = {"queries": total, "wall_s": round(wall, 3),
            "qps": round(total / wall, 1),
-           "p50_ms": round(percentile(lat, 0.50), 2),
-           "p99_ms": round(percentile(lat, 0.99), 2)}
+           "p50_ms": round(exact_quantile(lat, 0.50), 2),
+           "p99_ms": round(exact_quantile(lat, 0.99), 2)}
     log(f"serial: {total} queries in {wall:.2f}s = {rec['qps']} QPS, "
         f"p50 {rec['p50_ms']} ms, p99 {rec['p99_ms']} ms")
     rec["_hashes"] = hashes
@@ -173,9 +237,13 @@ def run_serial(wh_dir: str, pool, lists, log) -> dict:
 
 
 def run_service(wh_dir: str, pool, clients: int, lists,
-                serial_hashes: dict, record_queries: int, log) -> dict:
+                serial_hashes: dict, record_queries: int, log,
+                trace_dir: str | None = None,
+                flight_dump: str | None = None) -> dict:
     from nds_tpu.engine.jax_backend.executor import clear_shared_programs
+    from nds_tpu.obs.flight import FLIGHT
     from nds_tpu.obs.metrics import METRICS
+    from nds_tpu.obs.trace import TRACER
     from nds_tpu.service import QueryService, ServiceConfig
 
     clear_shared_programs()
@@ -266,7 +334,18 @@ def run_service(wh_dir: str, pool, clients: int, lists,
             with lock:
                 per_query.extend(rows)
 
+        # the measured window's observability state: the flight recorder
+        # rides along (sized to hold the whole window) and the histogram
+        # cut isolates the window from warmup via snapshot diffs
+        # ~4 ring events per query (admit/plan/complete + shared batch
+        # rows) — size so the window's completes all survive eviction
+        FLIGHT.configure(enabled=True,
+                         capacity=4 * sum(len(x) for x in lists) + 512,
+                         clear=True)
+        if trace_dir:
+            TRACER.configure(enabled=True)
         before = METRICS.snapshot()
+        h_before = METRICS.histograms()
         threads = [threading.Thread(target=client, args=(cid, ql))
                    for cid, ql in enumerate(lists)]
         t0 = time.perf_counter()
@@ -276,12 +355,30 @@ def run_service(wh_dir: str, pool, clients: int, lists,
             t.join()
         wall = time.perf_counter() - t0
         delta = METRICS.delta(before)
+        h_after = METRICS.histograms()
     finally:
         svc.close()
 
-    lat = sorted(r["latency_ms"] for r in per_query)
-    waits = sorted(r["queue_wait_ms"] for r in per_query
-                   if r["queue_wait_ms"] is not None)
+    trace_file = None
+    if trace_dir:
+        trace_file = TRACER.write_chrome_trace(os.path.join(
+            trace_dir, f"service_trace_c{clients}.json"))
+        TRACER.configure(enabled=False)
+        log(f"trace: {trace_file} (open in ui.perfetto.dev)")
+    flight_file = None
+    if flight_dump:
+        flight_file = FLIGHT.dump_jsonl(
+            flight_dump.replace(".jsonl", f"_c{clients}.jsonl"))
+    # service-side latency percentiles now come from the REGISTRY
+    # histograms (the per-tenant/per-template SLO source every consumer
+    # shares) — cross-checked below against exact per-ticket latencies
+    # from the flight recorder's complete events, within the documented
+    # bucket error bound
+    lat_hist = hist_window(h_before, h_after, "service_latency_ms")
+    wait_hist = hist_window(h_before, h_after, "service_queue_wait_ms")
+    exact_lat = sorted(e["latency_ms"] for e in FLIGHT.events()
+                       if e["event"] == "complete")
+    FLIGHT.configure(enabled=False)
     batched = [r for r in per_query if (r["batched_with"] or 0) > 0]
     total = sum(len(x) for x in lists)
     rec = {
@@ -291,10 +388,17 @@ def run_service(wh_dir: str, pool, clients: int, lists,
         "errors": errors[:10],
         "wall_s": round(wall, 3),
         "qps": round(len(per_query) / wall, 1) if wall else 0.0,
-        "p50_ms": round(percentile(lat, 0.50), 2),
-        "p99_ms": round(percentile(lat, 0.99), 2),
-        "queue_wait_p50_ms": round(percentile(waits, 0.50), 2),
-        "queue_wait_p99_ms": round(percentile(waits, 0.99), 2),
+        "p50_ms": _hq(lat_hist, 0.50),
+        "p99_ms": _hq(lat_hist, 0.99),
+        "queue_wait_p50_ms": _hq(wait_hist, 0.50),
+        "queue_wait_p99_ms": _hq(wait_hist, 0.99),
+        "percentile_check": _percentile_check(lat_hist, exact_lat),
+        "per_tenant_slo": _tenant_slo(h_before, h_after, top=8),
+        # the raw window snapshots: any quantile is recomputable offline
+        # (obs_report / quantile_from_snapshot), and shard-level records
+        # merge via merge_snapshots
+        "latency_hist": lat_hist,
+        "queue_wait_hist": wait_hist,
         "batched_frac": round(len(batched) / max(1, len(per_query)), 3),
         "admission_rejection_retries": rejection_retries[0],
         # engine-counter delta over the MEASURED window (warmup excluded):
@@ -310,6 +414,10 @@ def run_service(wh_dir: str, pool, clients: int, lists,
         # execute, plus who rode a shared batched dispatch
         "queries_sample": per_query[:record_queries],
     }
+    if trace_file:
+        rec["trace_file"] = trace_file
+    if flight_file:
+        rec["flight_file"] = flight_file
     log(f"clients={clients}: {rec['qps']} QPS ({total} queries in "
         f"{wall:.2f}s), p50 {rec['p50_ms']} ms, p99 {rec['p99_ms']} ms, "
         f"batched {rec['batched_frac']:.0%}, "
@@ -330,6 +438,17 @@ def main(argv=None) -> int:
                         "the same amount of work)")
     p.add_argument("--record_queries", type=int, default=200,
                    help="per-query rows kept in the JSON (cap)")
+    p.add_argument("--trace", action="store_true",
+                   help="span-trace each measured window; writes one "
+                        "Chrome trace-event file per client count "
+                        "(service_trace_cN.json beside --out) showing the "
+                        "parent-linked admission->plan->dispatch->"
+                        "materialize spans of every ticket")
+    p.add_argument("--flight", action="store_true",
+                   help="also dump each measured window's flight-recorder "
+                        "ring as service_flight_cN.jsonl beside --out "
+                        "(the ring records regardless — it feeds the "
+                        "exact-percentile cross-check)")
     p.add_argument("--out", default=os.path.join(REPO, "SERVICE_r01.json"))
     p.add_argument("--sf", default=os.environ.get("NDS_TPU_BENCH_SF",
                                                   "0.01"))
@@ -358,17 +477,21 @@ def main(argv=None) -> int:
     # equal sustained work, not unequal totals
     serial = run_serial(wh_dir, pool, lists_for(max(counts)), log)
     hashes = serial.pop("_hashes")
+    out_dir = os.path.dirname(os.path.abspath(a.out))
     runs = []
     for c in counts:
-        rec = run_service(wh_dir, pool, c, lists_for(c), hashes,
-                          a.record_queries, log)
+        rec = run_service(
+            wh_dir, pool, c, lists_for(c), hashes, a.record_queries, log,
+            trace_dir=out_dir if a.trace else None,
+            flight_dump=os.path.join(out_dir, "service_flight.jsonl")
+            if a.flight else None)
         rec["speedup_vs_serial_qps"] = round(
             rec["qps"] / serial["qps"], 2) if serial["qps"] else None
         runs.append(rec)
 
     import platform
     out = {
-        "schema_version": 1,
+        "schema_version": 2,
         "kind": "service_open_loop",
         "sf": a.sf,
         "templates": {k: v for k, v in TEMPLATES.items()},

@@ -4,24 +4,33 @@
 Accepts any of the formats the obs layer emits and prints the aggregate
 view a Perfetto session would start from:
 
-- Chrome trace-event JSON (``bench.py --trace`` / ``power --trace``):
-  per-span-name rollup (count / total / mean / max ms) plus the slowest
-  individual spans with their attributes;
+- Chrome trace-event JSON (``bench.py --trace`` / ``power --trace`` /
+  ``service_bench.py --trace``): per-span-name rollup (count / total /
+  mean / max ms) plus the slowest individual spans with their attributes;
+  traces containing ``service/*`` spans additionally get a per-tenant
+  rollup and a slowest-ticket listing (the ``service/ticket`` root spans
+  opened at admission);
 - JSONL event logs (one event per line, same rollup);
+- flight-recorder JSONL dumps (``obs.flight``): per-event-type counts,
+  per-tenant rollup, and the slowest completed tickets;
 - bench JSON lines (the ``bench.py`` stdout object): the per-program
-  device-time table, per-query attribution fractions, and the engine
-  metrics snapshot.
+  device-time table, per-query attribution fractions, the engine metrics
+  snapshot, and (schema >= 3) histogram quantile tables.
 
 Usage:  python scripts/trace_report.py ARTIFACT [--top N]
 
-Pure stdlib; safe to point at artifacts from any round (schema_version
-tolerant — unknown keys are ignored).
+Stdlib plus the dependency-free ``nds_tpu.obs.metrics`` (histogram
+quantile math); safe to point at artifacts from any round
+(schema_version tolerant — unknown keys are ignored).
 """
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def load_events(path: str) -> list[dict] | None:
@@ -90,6 +99,97 @@ def print_slowest(events: list[dict], top: int) -> None:
               f"{e['name']}{detail}  {args}")
 
 
+def print_service_view(events: list[dict], top: int) -> None:
+    """Service-trace extras: per-tenant rollup over the ``service/ticket``
+    root spans and the slowest tickets (label, latency, batch company)."""
+    tickets = [e for e in events
+               if e.get("ph") == "X" and e.get("name") == "service/ticket"]
+    if not tickets:
+        return
+    tenants: dict[str, dict] = {}
+    for e in tickets:
+        t = (e.get("args") or {}).get("tenant", "?")
+        row = tenants.setdefault(t, {"count": 0, "total_ms": 0.0,
+                                     "max_ms": 0.0, "errors": 0})
+        ms = e.get("dur", 0) / 1000.0
+        row["count"] += 1
+        row["total_ms"] += ms
+        row["max_ms"] = max(row["max_ms"], ms)
+        if (e.get("args") or {}).get("error"):
+            row["errors"] += 1
+    print(f"\nservice tickets by tenant ({len(tickets)} tickets):")
+    head = (f"{'tenant':<16} {'tickets':>8} {'mean_ms':>9} {'max_ms':>9} "
+            f"{'errors':>7}")
+    print(head)
+    print("-" * len(head))
+    for t, r in sorted(tenants.items(), key=lambda kv: -kv[1]["max_ms"]):
+        print(f"{t[:16]:<16} {r['count']:>8} "
+              f"{r['total_ms'] / r['count']:>9.1f} {r['max_ms']:>9.1f} "
+              f"{r['errors']:>7}")
+    slow = sorted(tickets, key=lambda e: e.get("dur", 0),
+                  reverse=True)[:top]
+    print(f"\nslowest {len(slow)} tickets:")
+    for e in slow:
+        args = e.get("args", {})
+        print(f"  {e.get('dur', 0) / 1000.0:>9.1f} ms  "
+              f"{args.get('label', '?')}  tenant={args.get('tenant', '?')}"
+              f"{'  ERROR=' + args['error'] if args.get('error') else ''}")
+
+
+def is_flight_log(events: list[dict]) -> bool:
+    """Flight-recorder dumps are JSONL like trace event logs but carry
+    ``event``/``t_ms`` instead of Chrome's ``ph``/``ts``."""
+    return bool(events) and all(
+        isinstance(e, dict) and "event" in e and "ph" not in e
+        for e in events)
+
+
+def print_flight(events: list[dict], top: int) -> None:
+    """Flight-recorder dump: event-type counts, per-tenant rollup, and
+    the slowest completed tickets."""
+    kinds: dict[str, int] = {}
+    tenants: dict[str, dict] = {}
+    for e in events:
+        kinds[e["event"]] = kinds.get(e["event"], 0) + 1
+        t = e.get("tenant")
+        if t is None:
+            continue
+        row = tenants.setdefault(t, {"complete": 0, "reject": 0,
+                                     "expire": 0, "error": 0,
+                                     "total_ms": 0.0, "max_ms": 0.0})
+        k = e["event"]
+        if k in row:
+            row[k] += 1
+        if k == "complete" and e.get("latency_ms") is not None:
+            row["total_ms"] += e["latency_ms"]
+            row["max_ms"] = max(row["max_ms"], e["latency_ms"])
+    span_s = (events[-1]["t_ms"] - events[0]["t_ms"]) / 1000.0 \
+        if len(events) > 1 else 0.0
+    print(f"flight recorder: {len(events)} events over {span_s:.1f}s")
+    for k in sorted(kinds, key=lambda k: -kinds[k]):
+        print(f"  {k:<10} {kinds[k]}")
+    if tenants:
+        head = (f"\n{'tenant':<16} {'complete':>9} {'reject':>7} "
+                f"{'expire':>7} {'error':>6} {'mean_ms':>9} {'max_ms':>9}")
+        print(head)
+        print("-" * (len(head) - 1))
+        for t, r in sorted(tenants.items(),
+                           key=lambda kv: -kv[1]["max_ms"]):
+            mean = r["total_ms"] / r["complete"] if r["complete"] else 0.0
+            print(f"{t[:16]:<16} {r['complete']:>9} {r['reject']:>7} "
+                  f"{r['expire']:>7} {r['error']:>6} {mean:>9.1f} "
+                  f"{r['max_ms']:>9.1f}")
+    done = sorted((e for e in events if e["event"] == "complete"
+                   and e.get("latency_ms") is not None),
+                  key=lambda e: -e["latency_ms"])[:top]
+    print(f"\nslowest {len(done)} tickets:")
+    for e in done:
+        extra = f"  batched_with={e['batched_with']}" \
+            if e.get("batched_with") else ""
+        print(f"  {e['latency_ms']:>9.1f} ms  {e.get('label', '?')}  "
+              f"tenant={e.get('tenant', '?')}{extra}")
+
+
 def print_bench(doc: dict, top: int) -> None:
     print(f"bench: {doc.get('metric')} = {doc.get('value')} "
           f"{doc.get('unit', '')} (vs_baseline {doc.get('vs_baseline')})")
@@ -124,6 +224,18 @@ def print_bench(doc: dict, top: int) -> None:
         rows.sort(key=lambda r: r["total_ms"], reverse=True)
         print()
         print_rollup(rows)
+    hists = doc.get("histograms") or {}
+    if hists:
+        sys.path.insert(0, REPO)
+        from nds_tpu.obs.metrics import quantile_from_snapshot
+        print("\nhistograms (count / p50 / p95 / p99 / max ms):")
+        for key, snap in sorted(hists.items()):
+            qs = [quantile_from_snapshot(snap, p)
+                  for p in (0.5, 0.95, 0.99)]
+            qtxt = " ".join(f"{q:>9.1f}" if q is not None else f"{'-':>9}"
+                            for q in qs)
+            print(f"  {key[:48]:<48} {snap['count']:>7} {qtxt} "
+                  f"{snap['max'] if snap['max'] is not None else 0:>9.1f}")
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -135,10 +247,14 @@ def main(argv: list[str] | None = None) -> int:
     a = p.parse_args(argv)
     try:
         events = load_events(a.artifact)
+        if events is not None and is_flight_log(events):
+            print_flight(events, a.top)
+            return 0
         if events is not None and events and \
                 all(isinstance(e, dict) and "ph" in e for e in events):
             print_rollup(rollup(events))
             print_slowest(events, a.top)
+            print_service_view(events, a.top)
             return 0
         with open(a.artifact) as f:
             doc = json.load(f)

@@ -55,6 +55,16 @@
 #                thread hops, flight-recorder ring overflow and fault-
 #                triggered dumps; the 100-client open-loop run carries
 #                the slow marker and runs in the full `test` stage
+#   chaos      - chaos-hardened serving: circuit breaker / retry budget /
+#                program quarantine / lane watchdog under REAL injected
+#                faults, a seeded ~8-client campaign against the live
+#                service (0 untyped failures, 0 hash mismatches, flight
+#                dump per firing), and the crash-resumable scored
+#                lifecycle's checkpoint/resume/score machinery
+#                (tests/test_chaos.py + tests/test_lifecycle.py); the
+#                100-client campaign and the real SF0.001 kill+resume /
+#                chaos lifecycle runs carry the slow marker and run in
+#                the full `test` stage
 #   metrics_gate - diff the deterministic gate workload's COUNT-shaped
 #                engine counters (compiles, cache hits, morsels, batch
 #                sizes...) against cicd/metrics_baseline.json with
@@ -150,6 +160,15 @@ stage_service() {
         tests/test_obs_service.py -q -m 'not slow')
 }
 
+stage_chaos() {
+    # resilience as a verified property of the WHOLE stack: typed
+    # degradation, bit-stable completions, and self-healing (breaker,
+    # retry budget, quarantine, watchdog) under armed fault points with
+    # concurrent clients in flight, plus lifecycle resume determinism
+    (cd "$REPO" && python -m pytest tests/test_chaos.py \
+        tests/test_lifecycle.py -q -m 'not slow')
+}
+
 stage_metrics_gate() {
     # count-shaped counter diff vs the checked-in baseline: compiles,
     # cache hits, morsel/batch counts must stay in band on the fixed
@@ -182,16 +201,16 @@ run_stage() {
 }
 
 case "${1:-all}" in
-    native|resilience|static|planner|encoded|kernels|mesh|service|metrics_gate|test|bench)
+    native|resilience|static|planner|encoded|kernels|mesh|service|chaos|metrics_gate|test|bench)
         run_stage "$1" ;;
     all)
         total0=$SECONDS
         for s in native resilience static planner encoded kernels mesh \
-                 service metrics_gate test bench; do
+                 service chaos metrics_gate test bench; do
             run_stage "$s"
         done
         echo "stage all: $((SECONDS - total0))s" ;;
-    --list)     echo "native resilience static planner encoded kernels mesh service metrics_gate test bench all" ;;
-    *) echo "usage: run_ci.sh [native|resilience|static|planner|encoded|kernels|mesh|service|metrics_gate|test|bench|all|--list]" >&2
+    --list)     echo "native resilience static planner encoded kernels mesh service chaos metrics_gate test bench all" ;;
+    *) echo "usage: run_ci.sh [native|resilience|static|planner|encoded|kernels|mesh|service|chaos|metrics_gate|test|bench|all|--list]" >&2
        exit 2 ;;
 esac

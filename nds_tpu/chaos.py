@@ -1,0 +1,466 @@
+"""Chaos campaigns: seeded fault injection against the LIVE query service.
+
+The resilience layer (PR 1) gave the engine armable fault points and the
+service (PR 10) gave it real concurrency — this module finally runs them
+TOGETHER, the way a production engine earns trust: arm
+``arrow.read``/``device.put``/``jax.compile``/``jax.execute``/
+``stream.spawn``/``query.run`` specs while N concurrent clients are in
+flight, and verify that resilience is a property of the whole stack:
+
+- **bit-stability** — every response that COMPLETES under chaos is
+  hash-identical to the fault-free baseline (a fault may fail a query,
+  it must never corrupt one);
+- **typed degradation** — every failure a client sees is a typed,
+  classifiable error (FaultError, AdmissionRejected/CircuitOpen,
+  DeadlineExceeded, ...), never a bare exception or a wedged lane;
+- **post-mortem evidence** — the flight recorder dumps an artifact per
+  firing and per circuit trip (the campaign zeroes the trip cooldown);
+- **recovery** — after disarm, throughput returns toward the baseline
+  (the ratio is recorded; asserting it belongs to quiet-host artifact
+  runs, not 1-core CI).
+
+Determinism: the campaign PLAN (which specs arm, in which scheduled
+waves, with what actions/probabilities/caps) is a pure function of the
+seed, each spec's probability draws come from its own arm-order-seeded
+RNG (``FaultRegistry._seed_spec``), and the per-client workloads are
+seeded — so two runs of one seed arm the same schedule and, with certain
+(p=1, times-capped) specs, fire the same counts regardless of thread
+interleaving. With one client the whole flight-event sequence replays.
+
+``scripts/chaos_bench.py`` drives a 100-client campaign with all six
+points armed and records ``CHAOS_r01.json``; the CI ``chaos`` stage runs
+a small seeded campaign at ~8 clients (tests/test_chaos.py).
+"""
+from __future__ import annotations
+
+import hashlib
+import random
+import threading
+import time
+from collections import Counter
+from dataclasses import asdict, dataclass, field
+from typing import Optional
+
+from .obs.flight import FLIGHT
+from .obs.metrics import METRICS
+from .resilience import FAULT_POINTS, FAULTS, CircuitBreakerConfig, FaultSpec
+
+#: exception type names (matched over the MRO, so subclasses count) a
+#: chaos client is ALLOWED to see — the typed-degradation contract.
+#: Anything else is an untyped escape and fails the campaign invariant.
+TYPED_ERRORS = frozenset({
+    "FaultError", "TransientError", "AdmissionRejected", "CircuitOpen",
+    "ServiceClosed", "DeadlineExceeded", "TimeoutError",
+})
+
+
+def is_typed(exc: BaseException) -> bool:
+    return bool({c.__name__ for c in type(exc).__mro__} & TYPED_ERRORS)
+
+
+@dataclass
+class CampaignSpec:
+    """One seeded campaign's shape. Everything the firing schedule and
+    workload depend on lives here, so the spec IS the reproducer."""
+    seed: int = 0xC0FFEE
+    clients: int = 8
+    queries_per_client: int = 8
+    #: fault points the plan arms (default: all six)
+    points: tuple = FAULT_POINTS
+    #: firings cap per armed spec (``times``): bounds the blast radius
+    #: and, with probability 1.0, makes fired counts deterministic
+    times_per_point: int = 2
+    #: per-spec firing probability (1.0 = certain; <1 draws from the
+    #: spec's own seeded RNG in its firing order)
+    probability: float = 1.0
+    #: actions the plan draws from per spec ("hang" only makes sense with
+    #: the lane watchdog armed — see dispatch_timeout_s)
+    actions: tuple = ("raise", "delay")
+    #: a second scheduled wave arms after this fraction of the armed
+    #: phase's queries complete (0 disables the pulse)
+    pulse_at: float = 0.5
+    #: per-query service deadline (seconds; 0 = none)
+    deadline_s: float = 60.0
+    #: client retry attempts for transient admission rejections
+    admission_retries: int = 3
+    # -- self-healing service knobs the campaign arms -----------------------
+    breaker: bool = True
+    breaker_open_s: float = 1.0
+    breaker_min_failures: int = 4
+    retry_budget: int = 64
+    ticket_attempts: int = 2
+    dispatch_timeout_s: float = 0.0
+    #: flight artifacts directory (None = no dumps, ring only)
+    dump_dir: Optional[str] = None
+
+    def __post_init__(self):
+        unknown = [p for p in self.points if p not in FAULT_POINTS]
+        if unknown:
+            raise ValueError(f"unknown fault points {unknown} "
+                             f"(expected a subset of {FAULT_POINTS})")
+
+
+@dataclass
+class Wave:
+    """One scheduled arming: ``at_fraction`` of the armed phase's traffic
+    has completed when the wave's specs arm."""
+    at_fraction: float
+    specs: list = field(default_factory=list)   # [FaultSpec kwargs dicts]
+
+
+def build_plan(spec: CampaignSpec) -> list[Wave]:
+    """The deterministic firing schedule: a pure function of the spec.
+
+    Wave 0 arms one spec per requested point at phase start; the pulse
+    wave (``pulse_at``) re-arms the raise-style points mid-phase so the
+    service is hit again AFTER its breaker/retry machinery has reacted
+    to the first burst. Actions, delay durations, and the pulse point
+    subset all come from one ``random.Random(seed)`` stream.
+    """
+    rng = random.Random(spec.seed)
+    wave0 = Wave(at_fraction=0.0)
+    for point in spec.points:
+        action = spec.actions[rng.randrange(len(spec.actions))]
+        seconds = round(rng.uniform(0.02, 0.15), 3) \
+            if action in ("delay", "hang") else 0.0
+        if action == "hang":    # bounded: the watchdog must outlive it
+            seconds = max(seconds, 1.0)
+        wave0.specs.append(dict(point=point, action=action,
+                                seconds=seconds,
+                                probability=spec.probability,
+                                times=spec.times_per_point))
+    waves = [wave0]
+    if spec.pulse_at > 0:
+        pulse = Wave(at_fraction=spec.pulse_at)
+        pulse_points = [p for p in spec.points if rng.random() < 0.5]
+        if not pulse_points:
+            pulse_points = [spec.points[rng.randrange(len(spec.points))]]
+        for point in pulse_points:
+            pulse.specs.append(dict(point=point, action="raise",
+                                    probability=spec.probability,
+                                    times=max(1,
+                                              spec.times_per_point // 2)))
+        waves.append(pulse)
+    return waves
+
+
+def build_workload(spec: CampaignSpec, pool: list) -> dict[int, list]:
+    """{client_id: [(label, sql)]}: seeded draws from the instantiation
+    pool, one independent stream per client (dashboard shape: heavy
+    cross-client repetition)."""
+    out = {}
+    for cid in range(spec.clients):
+        rng = random.Random(f"{spec.seed}:workload:{cid}")
+        out[cid] = [pool[rng.randrange(len(pool))]
+                    for _ in range(spec.queries_per_client)]
+    return out
+
+
+def result_hash(table) -> str:
+    """Stable content hash of a query result (rows are ordered — campaign
+    templates carry ORDER BY)."""
+    return hashlib.sha1(repr(table.to_pylist()).encode()).hexdigest()
+
+
+class ChaosCampaign:
+    """Drive one seeded campaign against a QueryService over ``session``.
+
+    Three phases through ONE live service: fault-free ``baseline``
+    (collects the reference hash per distinct text and the reference
+    QPS), ``armed`` (the plan's waves arm on schedule while the clients
+    run), and ``recovery`` (everything disarmed, QPS re-measured).
+    """
+
+    def __init__(self, spec: CampaignSpec, pool: list):
+        self.spec = spec
+        #: [(label, sql)] instantiation pool clients draw from
+        self.pool = list(pool)
+        self.plan = build_plan(spec)
+        self._armed: list[FaultSpec] = []
+
+    # -- phases --------------------------------------------------------------
+    def _client(self, svc, cid: int, queries: list, state: dict) -> None:
+        """One client thread: fire stream.spawn at startup (a chaos
+        client IS a stream attempt — the spawn point kills client
+        startups), then submit-and-wait each query, firing query.run the
+        way the power runner does. Typed failures are recorded and the
+        client moves on; transient admission rejections back off briefly
+        and retry (the intended client response to overload)."""
+        try:
+            FAULTS.fire("stream.spawn", f"client{cid}")
+        except Exception as e:
+            # a killed client startup fails the whole client's stream,
+            # typed; its queries still count toward the phase's schedule
+            # thresholds so the driver never stalls on a dead client
+            with state["lock"]:
+                if is_typed(e):
+                    state["typed"][type(e).__name__] += 1
+                else:
+                    state["untyped"].append(
+                        f"client{cid} spawn: {type(e).__name__}: {e}")
+                state["done"] += len(queries)
+            return
+        for label, sql in queries:
+            err: Optional[BaseException] = None
+            table = None
+            for attempt in range(1 + self.spec.admission_retries):
+                try:
+                    FAULTS.fire("query.run", label)
+                    t = svc.submit(sql, label=label,
+                                   tenant=f"client{cid}",
+                                   deadline_s=self.spec.deadline_s or None)
+                    table = t.result(timeout=300)
+                    err = None
+                    break
+                except Exception as e:
+                    err = e
+                    # only overload-shaped rejections are worth an
+                    # immediate client retry; CircuitOpen classifies
+                    # fatal (wait for a probe), faults just failed
+                    names = {c.__name__ for c in type(e).__mro__}
+                    if "AdmissionRejected" not in names \
+                            or "CircuitOpen" in names:
+                        break
+                    time.sleep(0.01 * (attempt + 1))
+            with state["lock"]:
+                state["done"] += 1
+                if err is None:
+                    h = result_hash(table)
+                    state["completed"] += 1
+                    base = state["baseline_hashes"]
+                    if base is not None and sql in base \
+                            and base[sql] != h:
+                        state["mismatches"].append(label)
+                    state["hashes"].setdefault(sql, h)
+                elif is_typed(err):
+                    state["typed"][type(err).__name__] += 1
+                else:
+                    state["untyped"].append(
+                        f"{label}: {type(err).__name__}: {err}")
+
+    def _run_phase(self, svc, name: str,
+                   baseline_hashes: Optional[dict] = None,
+                   driver=None) -> dict:
+        workload = build_workload(self.spec, self.pool)
+        total = sum(len(q) for q in workload.values())
+        state = {"lock": threading.Lock(), "done": 0, "completed": 0,
+                 "typed": Counter(), "untyped": [], "mismatches": [],
+                 "hashes": {}, "baseline_hashes": baseline_hashes,
+                 "total": total}
+        FLIGHT.record("lifecycle_phase", phase=f"chaos:{name}",
+                      status="start", clients=self.spec.clients)
+        before = METRICS.snapshot()
+        threads = [threading.Thread(
+            target=self._client, args=(svc, cid, qs, state),
+            name=f"chaos-client-{cid}", daemon=True)
+            for cid, qs in workload.items()]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        if driver is not None:
+            driver(state)
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        delta = METRICS.delta(before)
+        FLIGHT.record("lifecycle_phase", phase=f"chaos:{name}",
+                      status="end", completed=state["completed"],
+                      wall_s=round(wall, 3))
+        return {"wall_s": round(wall, 3),
+                "queries": total,
+                "completed": state["completed"],
+                "qps": round(state["completed"] / wall, 3) if wall else 0.0,
+                "typed_failures": dict(state["typed"]),
+                "untyped_failures": state["untyped"][:10],
+                "untyped_count": len(state["untyped"]),
+                "hash_mismatches": state["mismatches"][:10],
+                "hash_mismatch_count": len(state["mismatches"]),
+                "hashes": state["hashes"],
+                "metrics_delta": delta}
+
+    def _arm_wave(self, wave: Wave) -> None:
+        for kw in wave.specs:
+            self._armed.append(FAULTS.arm(FaultSpec(**kw)))
+
+    def _driver(self, state: dict) -> None:
+        """The scheduled-arming driver: waves arm when the completed
+        fraction of the armed phase's traffic crosses their threshold
+        (count-based, not time-based — the schedule is load-relative and
+        replays across hosts of different speeds). Zero-threshold waves
+        were already armed before the clients started (``stream.spawn``
+        must be live when the first client fires it)."""
+        waves = sorted((w for w in self.plan if w.at_fraction > 0),
+                       key=lambda w: w.at_fraction)
+        for wave in waves:
+            while True:
+                with state["lock"]:
+                    done, total = state["done"], state["total"]
+                if done >= wave.at_fraction * total:
+                    break
+                if done >= total:
+                    return
+                time.sleep(0.005)
+            self._arm_wave(wave)
+
+    def disarm(self) -> list[dict]:
+        """Disarm every campaign spec; returns their fired counts (the
+        measured firing schedule)."""
+        fired = []
+        for s in self._armed:
+            fired.append({"point": s.point, "action": s.action,
+                          "probability": s.probability, "times": s.times,
+                          "fired": s.fired})
+            FAULTS.disarm(s)
+        self._armed = []
+        return fired
+
+    # -- the campaign --------------------------------------------------------
+    def run(self, session, service_config=None) -> dict:
+        """Run baseline -> armed -> recovery through one live service;
+        returns the campaign record (the ``CHAOS_r*.json`` shape)."""
+        from .service import QueryService, ServiceConfig
+
+        spec = self.spec
+        # the recorder is process-global: remember its settings so the
+        # campaign's zeroed cooldown / private dump dir don't leak into
+        # whatever runs after (restored in the finally below)
+        prev_flight = (FLIGHT.enabled, FLIGHT.dump_dir,
+                       FLIGHT.trip_cooldown_s)
+        # ring sized so a whole campaign's lifecycle events fit: the
+        # fault-event census and determinism comparisons read the ring
+        capacity = max(4096,
+                       80 * spec.clients * spec.queries_per_client)
+        FLIGHT.configure(enabled=True, trip_cooldown_s=0.0,
+                         capacity=capacity, clear=True)
+        # explicit (configure treats None as "keep"): a dump-less campaign
+        # must not inherit a previous run's artifact directory
+        FLIGHT.dump_dir = spec.dump_dir
+        cfg = service_config or ServiceConfig(
+            max_pending=max(256, 4 * spec.clients),
+            breaker=CircuitBreakerConfig(
+                open_s=spec.breaker_open_s,
+                min_failures=spec.breaker_min_failures)
+            if spec.breaker else None,
+            retry_budget=spec.retry_budget,
+            ticket_attempts=spec.ticket_attempts,
+            dispatch_timeout_s=spec.dispatch_timeout_s)
+        try:
+            with QueryService(session, cfg) as svc:
+                # publish every template's shared program (record +
+                # compile) so the armed phase exercises the batched path
+                for _label, sql in self.pool:
+                    svc.sql(sql, label="chaos_warm")
+                    svc.sql(sql, label="chaos_warm")
+                baseline = self._run_phase(svc, "baseline")
+                # zero-threshold waves arm BEFORE the armed phase's
+                # clients start (stream.spawn must be live for the first
+                # client); the driver handles the scheduled >0 waves
+                for wave in self.plan:
+                    if wave.at_fraction <= 0:
+                        self._arm_wave(wave)
+                armed = self._run_phase(
+                    svc, "armed", baseline_hashes=baseline["hashes"],
+                    driver=self._driver)
+                fired = self.disarm()
+                recovery = self._run_phase(
+                    svc, "recovery", baseline_hashes=baseline["hashes"])
+        finally:
+            self.disarm()
+            (FLIGHT.enabled, FLIGHT.dump_dir,
+             FLIGHT.trip_cooldown_s) = prev_flight
+        fault_events = [
+            {"point": e.get("point"), "detail": e.get("detail")}
+            for e in FLIGHT.events() if e["event"] == "fault"]
+        trip_events = [e for e in FLIGHT.events() if e["event"] == "trip"]
+        firings = len(fault_events)
+        dumps = list(FLIGHT.dumps)
+        qps_ratio = (recovery["qps"] / baseline["qps"]) \
+            if baseline["qps"] else None
+        for phase in (baseline, armed, recovery):
+            phase.pop("hashes")     # bulky; the comparison already ran
+        record = {
+            "schema_version": 1,
+            "spec": asdict(spec),
+            "plan": [{"at_fraction": w.at_fraction, "specs": w.specs}
+                     for w in self.plan],
+            "fired": fired,
+            "phases": {"baseline": baseline, "armed": armed,
+                       "recovery": recovery},
+            "firings": firings,
+            "firings_specs": armed["metrics_delta"].get(
+                "fault_point_firings", 0),
+            "fault_events": fault_events,
+            "trips": len(trip_events),
+            "flight_dumps": len(dumps),
+            "flight_dump_paths": dumps[:20],
+            "recovery_qps_ratio": round(qps_ratio, 4)
+            if qps_ratio is not None else None,
+            "invariants": {
+                # the campaign's acceptance bar, evaluated inline so the
+                # artifact is self-judging
+                "all_failures_typed":
+                    armed["untyped_count"] == 0
+                    and recovery["untyped_count"] == 0
+                    and baseline["untyped_count"] == 0,
+                "completed_hash_identical":
+                    armed["hash_mismatch_count"] == 0
+                    and recovery["hash_mismatch_count"] == 0,
+                "flight_dump_per_firing":
+                    spec.dump_dir is None or len(dumps) >= firings,
+                "qps_recovered_within_20pct":
+                    qps_ratio is not None and qps_ratio >= 0.8,
+            },
+        }
+        return record
+
+
+def build_demo_session(work_dir: str, chunk_rows: int = 8192,
+                       out_of_core_min_rows: int = 10_000):
+    """A self-contained chaos target: synthetic fact/dim in-core tables
+    (the batched-dispatch path) plus a parquet-backed streamed table (the
+    serial/morsel path, so arrow.read and device.put fire per morsel).
+    Used by scripts/chaos_bench.py and the CI campaign tests."""
+    import os
+
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from .config import EngineConfig
+    from .engine import Session
+
+    rng = np.random.default_rng(23)
+    n_fact, n_dim = 20_000, 50
+    fact = pa.table({
+        "fk": pa.array(rng.integers(0, n_dim, n_fact), type=pa.int64()),
+        "qty": pa.array(rng.integers(1, 100, n_fact), type=pa.int64()),
+    })
+    dim = pa.table({"dk": pa.array(np.arange(n_dim), type=pa.int64()),
+                    "grp": pa.array((np.arange(n_dim) % 7)
+                                    .astype(np.int64))})
+    spath = os.path.join(work_dir, "sfact.parquet")
+    pq.write_table(pa.table({
+        "k": pa.array(rng.integers(0, 9, 60_000), type=pa.int32()),
+        "v": pa.array(rng.integers(0, 1000, 60_000), type=pa.int64()),
+    }), spath, row_group_size=chunk_rows)
+    session = Session(EngineConfig(chunk_rows=chunk_rows,
+                                   out_of_core_min_rows=out_of_core_min_rows))
+    session.register_arrow("fact", fact)
+    session.register_arrow("dim", dim)
+    session.register_parquet("sfact", spath)
+    return session
+
+
+def demo_pool() -> list:
+    """The demo session's instantiation pool: one parameterized in-core
+    template (compatible fingerprints -> batched dispatches) and one
+    streamed scan (serial lane, morsel staging under fire)."""
+    tpl = ("SELECT grp, COUNT(*) AS n, SUM(qty) AS tq FROM fact "
+           "JOIN dim ON fk = dk WHERE qty BETWEEN {a} AND {b} "
+           "GROUP BY grp ORDER BY grp")
+    pool = [(f"incore#{i}", tpl.format(a=5 + i, b=60 + 2 * i))
+            for i in range(6)]
+    pool.append(("streamed#0",
+                 "SELECT k, COUNT(*) AS n, SUM(v) AS sv FROM sfact "
+                 "GROUP BY k ORDER BY k"))
+    return pool

@@ -614,6 +614,11 @@ def pack_table(table: Table, capacity: Optional[int] = None,
     n = table.num_rows
     cap = capacity if capacity is not None else bucket(n)
     from ...obs.trace import TRACER
+    from ...resilience import FAULTS
+    # the packed-upload twin of to_device's fault point: streamed morsels
+    # ride this path exclusively, so chaos campaigns arming device.put
+    # must reach them too (one firing per staged morsel upload)
+    FAULTS.fire("device.put")
     with TRACER.span("lane.pack", cat="upload", rows=n,
                      cols=len(table.columns), capacity=cap):
         return _pack_table(table, lanes, n, cap, encs, codebooks)

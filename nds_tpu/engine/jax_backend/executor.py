@@ -103,11 +103,59 @@ class _Recorder:
 _SHARED_PROGRAMS: dict = {}
 _SHARED_LOCK = threading.Lock()
 
+#: fault/ReplayMismatch strikes per shared fingerprint (quarantine below)
+_PROGRAM_STRIKES: dict = {}
+#: strikes before a shared entry is quarantined (evicted, re-recorded on
+#: next use). One strike is normal life — a single capacity drift or a
+#: transient device fault repairs through the serial fallback; the same
+#: entry failing repeatedly means the PROGRAM is poisoned (bad schedule,
+#: corrupted executable) and every adopter inherits the failure.
+QUARANTINE_STRIKES = 3
+
 
 def clear_shared_programs() -> None:
     """Test hook: drop all cross-session shared programs."""
     with _SHARED_LOCK:
         _SHARED_PROGRAMS.clear()
+        _PROGRAM_STRIKES.clear()
+
+
+def strike_shared_program(fp: Optional[str], reason: str = "") -> bool:
+    """Record one fault/ReplayMismatch strike against a shared program.
+
+    At QUARANTINE_STRIKES the entry is QUARANTINED: evicted from
+    _SHARED_PROGRAMS (and its strike history cleared) so the next use
+    re-records and re-publishes a fresh schedule/program instead of every
+    adopter replaying the poisoned one. Returns True when this strike
+    evicted the entry. Thread-safe; counted in ``quarantined_programs``
+    and recorded as a flight ``quarantine`` event.
+    """
+    if fp is None:
+        return False
+    with _SHARED_LOCK:
+        n = _PROGRAM_STRIKES.get(fp, 0) + 1
+        _PROGRAM_STRIKES[fp] = n
+        if n < QUARANTINE_STRIKES:
+            return False
+        _PROGRAM_STRIKES.pop(fp, None)
+        if _SHARED_PROGRAMS.pop(fp, None) is None:
+            return False
+    from ...obs.flight import FLIGHT
+    from ...obs.metrics import QUARANTINED_PROGRAMS
+    QUARANTINED_PROGRAMS.inc()
+    FLIGHT.record("quarantine", fp=fp[:12], strikes=n,
+                  reason=reason or "repeated failures")
+    return True
+
+
+def absolve_shared_program(fp: Optional[str]) -> None:
+    """A successful run through the shared entry: clear its strikes
+    (strikes mark a PERSISTENTLY failing program, not one that hiccuped
+    once between healthy runs)."""
+    if fp is None:
+        return
+    with _SHARED_LOCK:
+        _PROGRAM_STRIKES.pop(fp, None)
 
 
 def shared_fingerprint(pplan, shard_min_rows: int,
@@ -1020,6 +1068,25 @@ class JaxExecutor:
                     and sh.get("cq") is None \
                     and sh["decisions"] == ent["decisions"]:
                 sh["cq"] = ent["cq"]
+
+    def evict_fp(self, fp: Optional[str]) -> int:
+        """Drop every LOCAL plan entry (and batched wrapper) published
+        under shared fingerprint ``fp`` — the quarantine follow-through:
+        after ``strike_shared_program`` evicts the shared entry, the
+        owning session must also forget its local copy so the next
+        sighting re-records and re-publishes a fresh schedule/program
+        instead of replaying the poisoned one. Returns entries dropped.
+        Call on the device lane / under the session statement lock (plan
+        caches are single-writer there)."""
+        if fp is None:
+            return 0
+        gone = [k for k, ent in self._plans.items()
+                if isinstance(ent, dict) and ent.get("fp") == fp]
+        for k in gone:
+            del self._plans[k]
+        for k in [k for k in self._batched if k[0] == fp]:
+            del self._batched[k]
+        return len(gone)
 
     def run_param_batch(self, fp: Optional[str], rows: list,
                         ) -> Optional[list]:

@@ -80,15 +80,25 @@ def run_maintenance(warehouse_path: str, refresh_dir: str, time_log: str,
                     maintenance_queries: list[str] | None = None,
                     json_summary_folder: str | None = None,
                     backend: str | None = None,
-                    decimal: str | None = None
+                    decimal: str | None = None,
+                    session: Session | None = None
                     ) -> list[tuple[str, int, int, int]]:
+    """``session``: reuse a caller-owned Session (warehouse attached and
+    staging registered here) instead of building a fresh one — the
+    chaos-mode lifecycle runs maintenance beside live service traffic and
+    the flight recorder keeps the interleaving (``maintenance`` events
+    per refresh function)."""
     from .config import maybe_enable_compile_cache
+    from .obs.flight import FLIGHT
 
     maybe_enable_compile_cache()
-    config = EngineConfig()
-    from .config import apply_decimal
-    apply_decimal(config, decimal)
-    session = Session(config)
+    if session is None:
+        config = EngineConfig()
+        from .config import apply_decimal
+        apply_decimal(config, decimal)
+        session = Session(config)
+    else:
+        config = session.config
     wh = Warehouse(warehouse_path)
     session.attach_warehouse(wh)
     register_staging(session, refresh_dir)
@@ -115,6 +125,10 @@ def run_maintenance(warehouse_path: str, refresh_dir: str, time_log: str,
         elapsed = report.summary["queryTimes"][-1]
         status = report.summary["queryStatus"][-1]
         rows.append((func, start, start + elapsed, elapsed))
+        # the chaos-mode post-mortem view: refresh functions interleaved
+        # with live service admissions/dispatches in one flight ring
+        FLIGHT.record("maintenance", func=func, status=status, ms=elapsed,
+                      variants=len(variants))
         print(f"{func}: {status} in {elapsed} ms", flush=True)
         if json_summary_folder:
             report.write_summary(

@@ -12,16 +12,25 @@ being interesting: it is cheap enough to leave on for whole service runs
 - **on a typed-rejection storm** — ``reject_storm`` rejections inside
   ``reject_window_s`` seconds auto-dump once per cooldown, so the record
   of the overload's onset survives the overload;
-- **when a FaultRegistry point fires** — chaos runs (ROADMAP item 5) arm
-  ``device.put``/``jax.compile``/... specs mid-service and assert against
-  the dumped artifact: the ring holds the admissions, dispatches, and
-  batch compositions that surrounded the injected failure.
+- **when a FaultRegistry point fires** — chaos runs (``nds_tpu/chaos``)
+  arm ``device.put``/``jax.compile``/... specs mid-service and assert
+  against the dumped artifact: the ring holds the admissions, dispatches,
+  and batch compositions that surrounded the injected failure;
+- **when a circuit breaker trips** — a per-error-class failure storm
+  crossing its windowed rate dumps the window that tripped it
+  (``resilience.CircuitBreaker``), once per class per cooldown.
 
 Events are flat dicts: ``seq`` (total-order sequence number), ``t_ms``
 (monotonic ms since recorder start — immune to wall-clock steps), an
 ``event`` tag (admit / plan / dispatch / batch / retry / fault / reject /
-expire / complete / error / trip), and whatever fields the recording site
-attaches (label, tenant, template, latency_ms, ...).
+expire / complete / error / trip / probe / quarantine / lifecycle_phase /
+maintenance), and whatever fields the recording site attaches (label,
+tenant, template, latency_ms, ...). The self-healing vocabulary: ``trip``
+marks a breaker/watchdog/fault-storm moment (reason field), ``probe`` a
+half-open breaker admission or its closing outcome, ``quarantine`` a
+shared compiled program evicted after repeated strikes, and
+``lifecycle_phase``/``maintenance`` the scored-lifecycle runner's phase
+transitions interleaving with live service traffic.
 
 Disabled (the default outside the service) a record() is one attribute
 read — the same near-zero contract as the span tracer. Enable with
@@ -65,7 +74,11 @@ class FlightRecorder:
                   dump_dir: Optional[str] = None,
                   reject_storm: Optional[int] = None,
                   reject_window_s: Optional[float] = None,
+                  trip_cooldown_s: Optional[float] = None,
                   clear: bool = True) -> "FlightRecorder":
+        """``trip_cooldown_s`` 0 dumps on EVERY trip — chaos campaigns
+        set it so an artifact exists per firing (the default 30s keeps a
+        sustained production storm to one dump per window per reason)."""
         with self._lock:
             if capacity is not None:
                 self._ring = deque(self._ring, maxlen=capacity)
@@ -75,6 +88,8 @@ class FlightRecorder:
                 self.reject_storm = reject_storm
             if reject_window_s is not None:
                 self.reject_window_s = reject_window_s
+            if trip_cooldown_s is not None:
+                self.trip_cooldown_s = trip_cooldown_s
             if clear:
                 self._ring.clear()
                 self._rejects.clear()
@@ -136,9 +151,11 @@ class FlightRecorder:
         self.record("trip", reason=reason, dumped=not limited, **fields)
         if limited or not self.dump_dir:
             return None
+        safe = "".join(c if c.isalnum() or c in "-_" else "_"
+                       for c in reason)   # "circuit:FaultError" etc.
         path = os.path.join(
             self.dump_dir,
-            f"flight_{reason}_{int(time.time())}_{self._seq}.jsonl")
+            f"flight_{safe}_{int(time.time())}_{self._seq}.jsonl")
         self.dump_jsonl(path)
         with self._lock:
             self.dumps.append(path)

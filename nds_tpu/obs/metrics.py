@@ -593,6 +593,24 @@ SERVICE_QUEUE_WAIT_MS = METRICS.counter(
 SERVICE_QUEUE_DEPTH = METRICS.gauge(
     "service_queue_depth", "queries currently admitted but not finished "
     "(the admission-control pressure signal)")
+# Self-healing service mechanisms (chaos-hardened serving): the breaker,
+# retry budget, and program quarantine the chaos campaigns exercise —
+# all exactly zero on a healthy run (the metrics gate pins the first
+# two strict-zero on its clean workload)
+CIRCUIT_TRIPS = METRICS.counter(
+    "circuit_trips", "per-error-class circuit-breaker trips (incl. "
+    "half-open probe failures re-opening): admission then refuses work "
+    "with typed CircuitOpen until a probe succeeds")
+RETRY_BUDGET_SPENT = METRICS.counter(
+    "retry_budget_spent", "transient ticket failures re-dispatched off "
+    "the device lane by the service's bounded retry budget")
+QUARANTINED_PROGRAMS = METRICS.counter(
+    "quarantined_programs", "shared compiled-program cache entries "
+    "evicted after repeated faults/ReplayMismatches (re-recorded fresh "
+    "on next use instead of poisoning every adopter)")
+LIFECYCLE_PHASE_RETRIES = METRICS.counter(
+    "lifecycle_phase_retries", "scored-lifecycle phases re-run after a "
+    "failure (lifecycle.LifecycleRunner phase_attempts)")
 
 # Service latency distributions (histogram families): the base series
 # aggregates every query; the service also records per-(tenant, template)

@@ -231,7 +231,11 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
     maybe_enable_compile_cache()
     if trace:
         TRACER.configure(enabled=True)
-    check_json_summary_folder(json_summary_folder)
+    if not resume:
+        # a RESUMED run re-enters its own summary folder on purpose: the
+        # already-written summaries belong to the very run being
+        # continued, not to a stale previous one
+        check_json_summary_folder(json_summary_folder)
     config = EngineConfig.from_property_file(property_file)
     from .config import apply_decimal
     apply_decimal(config, decimal)
@@ -344,6 +348,7 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
                 return run_one_query(*a, **k)
 
             def attempt_fn(*a, _name=name, **k):
+                from .obs.flight import FLIGHT
                 from .resilience import DeadlineExceeded
                 try:
                     return run_with_deadline(run_fn, timeout_s, *a,
@@ -352,8 +357,11 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
                     # the abandoned worker may still hold the session's
                     # statement lock (it cannot be killed): swap in fresh
                     # locks so the NEXT query runs now instead of queueing
-                    # behind the zombie's hang
+                    # behind the zombie's hang — and flight-dump the
+                    # moment (the service lane watchdog mirrors this move)
                     session.abandon_inflight()
+                    FLIGHT.trip("query_watchdog", query=_name,
+                                budget_s=timeout_s)
                     raise
 
             if not _injected(name):

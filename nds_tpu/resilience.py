@@ -17,6 +17,11 @@ module supplies the primitives the runners build on:
   admission points (the query service's bounded queue, ``nds_tpu/service``)
   so overload surfaces as an immediate, classifiable error instead of an
   unbounded pile-up behind the accelerator.
+- :class:`CircuitOpen` / :class:`CircuitBreaker` — a per-error-class
+  failure-rate breaker for admission points: a class of failures crossing
+  its windowed rate trips the breaker open, new work is refused with the
+  typed :class:`CircuitOpen`, and after a cooldown a bounded number of
+  half-open PROBES test recovery (success closes, failure re-opens).
 - :class:`FaultRegistry` — named engine-level fault points
   (``arrow.read``, ``device.put``, ``jax.compile``, ``jax.execute``,
   ``stream.spawn``, ``query.run``) threaded through the engine and
@@ -25,9 +30,36 @@ module supplies the primitives the runners build on:
   runner grew (now sugar over ``query.run`` specs) and lets the retry /
   deadline / restart machinery be tested without a flaky device.
 
-Everything here is deterministic: backoff schedules are pure functions of
-the attempt number, and probabilistic fault draws come from a registry-
-seeded RNG, so a failing run replays identically.
+**RetryPolicy classification table** (how each typed failure class is
+handled by default — fatal wins when a type matches both lists):
+
+==================  =========  ==============================================
+exception           class      why
+==================  =========  ==============================================
+TransientError      transient  declared retryable by its raiser
+FaultError          transient  injected faults model transient infra failures
+JaxRuntimeError     transient  tunnel drops / remote-compile hiccups
+ConnectionError     transient  network blips
+TimeoutError        transient  slow dependency, not a broken one
+BrokenPipeError     transient  peer restarted; a retry reconnects
+AdmissionRejected   transient  overload: back off and resubmit is the
+                               intended client response (depth/limit carried)
+DeadlineExceeded    fatal      the budget is spent; retrying double-spends it
+CircuitOpen         fatal      permanent-until-probe: the breaker re-opens on
+                               every submit until a half-open probe succeeds,
+                               so client-side retry is wasted work — wait for
+                               ``retry_after_s`` or route elsewhere
+KeyboardInterrupt   fatal      interrupts must propagate
+SystemExit          fatal      interpreter is leaving
+<anything else>     transient  a mid-stream failure is worth one more try;
+                               the attempt bound caps the cost
+==================  =========  ==============================================
+
+Everything here is deterministic: backoff schedules (jitter included) are
+pure functions of the attempt number, and probabilistic fault draws come
+from PER-SPEC seeded RNGs in that spec's firing order — so a spec's
+firing-index set is a pure function of the registry seed and arming
+order, independent of which service thread happens to hit the point.
 """
 from __future__ import annotations
 
@@ -36,6 +68,7 @@ import os
 import random
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Iterable, Optional
 
@@ -66,28 +99,59 @@ class AdmissionRejected(RuntimeError):
         self.limit = limit
 
 
+class CircuitOpen(AdmissionRejected):
+    """A per-error-class circuit breaker is refusing admissions.
+
+    Subclasses AdmissionRejected (it IS a typed admission refusal), but
+    classifies FATAL under RetryPolicy — fatal wins over the inherited
+    transient name — because the breaker stays open until a half-open
+    probe succeeds: immediate client retry cannot help, only waiting
+    ``retry_after_s`` (or routing elsewhere) can."""
+
+    def __init__(self, message: str, error_class: str | None = None,
+                 retry_after_s: float | None = None):
+        super().__init__(message)
+        self.error_class = error_class
+        self.retry_after_s = retry_after_s
+
+
 # -- retry --------------------------------------------------------------------
 
 #: exception type names (searched over the whole MRO) retried by default.
 #: JaxRuntimeError covers tunnel drops / remote-compile hiccups without
 #: importing jax here; FaultError is transient by design (injected faults
-#: simulate transient infrastructure failures unless armed to repeat).
+#: simulate transient infrastructure failures unless armed to repeat);
+#: AdmissionRejected is the overload signal whose intended client response
+#: IS retry-after-backoff. Full rationale: module-docstring table.
 _TRANSIENT_NAMES = ("TransientError", "FaultError", "JaxRuntimeError",
-                    "ConnectionError", "TimeoutError", "BrokenPipeError")
-#: never retried: a blown deadline already consumed its budget, and
-#: interrupts must propagate.
-_FATAL_NAMES = ("DeadlineExceeded", "KeyboardInterrupt", "SystemExit")
+                    "ConnectionError", "TimeoutError", "BrokenPipeError",
+                    "AdmissionRejected")
+#: never retried: a blown deadline already consumed its budget, interrupts
+#: must propagate, and an open circuit re-rejects until a probe succeeds
+#: (CircuitOpen's MRO also carries AdmissionRejected — fatal wins).
+_FATAL_NAMES = ("DeadlineExceeded", "CircuitOpen", "KeyboardInterrupt",
+                "SystemExit")
 
 
 @dataclass
 class RetryPolicy:
     """Deterministic bounded retry: ``max_attempts`` tries, exponential
     backoff ``backoff_s * factor**(attempt-1)`` capped at ``max_backoff_s``.
+
+    ``jitter`` (0..1) spreads synchronized retriers: attempt k's backoff
+    stretches by up to ``jitter`` of itself using a DETERMINISTIC
+    pseudo-random fraction of the attempt number (a Weyl sequence — no
+    RNG state, so a failing run still replays identically), and the
+    jittered value stays capped at ``max_backoff_s``.
+
+    Classification ("transient" retries, "fatal" re-raises) follows the
+    module-docstring table; fatal wins when a type's MRO matches both.
     """
     max_attempts: int = 3
     backoff_s: float = 0.1
     backoff_factor: float = 2.0
     max_backoff_s: float = 30.0
+    jitter: float = 0.0
     transient_names: tuple = _TRANSIENT_NAMES
     fatal_names: tuple = _FATAL_NAMES
 
@@ -105,8 +169,13 @@ class RetryPolicy:
 
     def backoff(self, attempt: int) -> float:
         """Seconds to wait after failed attempt `attempt` (1-based)."""
-        return min(self.max_backoff_s,
-                   self.backoff_s * self.backoff_factor ** (attempt - 1))
+        base = self.backoff_s * self.backoff_factor ** (attempt - 1)
+        if self.jitter > 0:
+            # golden-ratio Weyl fraction of the attempt number: well
+            # spread across attempts, zero state, replays identically
+            frac = (attempt * 0.6180339887498949) % 1.0
+            base *= 1.0 + self.jitter * frac
+        return min(self.max_backoff_s, base)
 
     def call(self, fn: Callable, *args, label: str = "",
              sleep: Callable[[float], None] = time.sleep,
@@ -218,6 +287,178 @@ def run_with_deadline(fn: Callable, timeout_s: Optional[float], *args,
     return box.get("result")
 
 
+# -- circuit breaker ----------------------------------------------------------
+
+@dataclass
+class CircuitBreakerConfig:
+    """Knobs of one :class:`CircuitBreaker` (per-error-class windows)."""
+    #: outcomes tracked per error class (sliding window; successes count
+    #: toward every tracked class so rates decay as the engine heals)
+    window: int = 16
+    #: failures of one class required inside its window before the rate
+    #: can trip (a floor so one early failure at 1/1 = 100% never trips)
+    min_failures: int = 4
+    #: windowed failure fraction at/above which the class trips open
+    failure_rate: float = 0.5
+    #: seconds a tripped class stays open before half-open probes start
+    open_s: float = 2.0
+    #: concurrent probe admissions allowed while half-open
+    probes: int = 1
+    #: error-class names the breaker never counts (a ticket blowing its
+    #: OWN deadline budget says nothing about engine health)
+    exclude: tuple = ("DeadlineExceeded",)
+
+
+class _BreakerClass:
+    """One error class's window + state. Mutated only under the breaker
+    lock."""
+    __slots__ = ("state", "outcomes", "opened_at", "probes_out", "trips")
+
+    def __init__(self, window: int):
+        self.state = "closed"               # closed | open | half_open
+        self.outcomes: deque = deque(maxlen=window)   # True = failure
+        self.opened_at = 0.0
+        self.probes_out = 0
+        self.trips = 0
+
+
+class CircuitBreaker:
+    """Per-error-class circuit breaker for admission points.
+
+    The service reports every ticket outcome through :meth:`record`; each
+    FAILURE class (exception type name) keeps its own sliding window, so a
+    storm of one class (say FaultError from a sick device path) trips
+    without a healthy class's successes masking the rate. While a class is
+    OPEN, :meth:`admit` raises the typed :class:`CircuitOpen` (fatal under
+    RetryPolicy: permanent-until-probe). After ``open_s`` the class goes
+    HALF-OPEN: up to ``probes`` admissions pass through as probes — a
+    probe success closes the class (window cleared), a probe failure
+    re-opens it for another cooldown.
+
+    Trips and probes land in the flight recorder (``trip``/``probe``
+    events; a trip also dumps the ring — the moments post-mortems exist
+    for) and in the ``circuit_trips`` metric. ``clock`` is injectable for
+    deterministic tests.
+    """
+
+    def __init__(self, config: Optional[CircuitBreakerConfig] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.config = config or CircuitBreakerConfig()
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._classes: dict[str, _BreakerClass] = {}
+
+    def admit(self, label: str = "") -> Optional[str]:  # lint: thread-entry (every service client thread submits through this)
+        """Gate one admission. Raises :class:`CircuitOpen` when some error
+        class is open (or half-open with its probe slots taken). Returns
+        the error-class name this admission PROBES for (caller must pass
+        it back to :meth:`record`), or None for a normal admission."""
+        cfg = self.config
+        now = self._clock()
+        probe_for = None
+        with self._lock:
+            for cls, st in self._classes.items():
+                if st.state == "open":
+                    waited = now - st.opened_at
+                    if waited < cfg.open_s:
+                        raise CircuitOpen(
+                            f"circuit open for {cls} "
+                            f"({cfg.open_s - waited:.2f}s until probes)",
+                            error_class=cls,
+                            retry_after_s=cfg.open_s - waited)
+                    st.state = "half_open"
+                    st.probes_out = 0
+                if st.state == "half_open":
+                    if st.probes_out >= cfg.probes:
+                        raise CircuitOpen(
+                            f"circuit half-open for {cls}: probe slots "
+                            f"full ({cfg.probes} in flight)",
+                            error_class=cls, retry_after_s=0.0)
+                    if probe_for is None:
+                        st.probes_out += 1
+                        probe_for = cls
+        if probe_for is not None:
+            from .obs.flight import FLIGHT
+            FLIGHT.record("probe", error_class=probe_for, label=label)
+        return probe_for
+
+    def record(self, error_name: Optional[str] = None,
+               probe: Optional[str] = None, label: str = "") -> None:  # lint: thread-entry (device lane + client threads report outcomes)
+        """Report one outcome: ``error_name`` is the failure's type name
+        (None = success); ``probe`` is the class name admit() returned."""
+        cfg = self.config
+        excluded = error_name is not None and error_name in cfg.exclude
+        now = self._clock()
+        tripped: list[tuple[str, int, int]] = []
+        closed: Optional[str] = None
+        with self._lock:
+            if probe is not None:
+                st = self._classes.get(probe)
+                if st is not None and st.state == "half_open":
+                    st.probes_out = max(0, st.probes_out - 1)
+                    if excluded:
+                        pass    # no health signal: slot freed, stay half-open
+                    elif error_name is None:
+                        st.state = "closed"
+                        st.outcomes.clear()
+                        closed = probe
+                    else:
+                        # ANY failure of a probe (even another class) says
+                        # the engine is still sick: re-open for a cooldown
+                        st.state = "open"
+                        st.opened_at = now
+                        st.trips += 1
+                        tripped.append((probe, st.trips,
+                                        sum(st.outcomes)))
+            if excluded:
+                pass            # an excluded class teaches the windows nothing
+            elif error_name is None:
+                for st in self._classes.values():
+                    st.outcomes.append(False)
+            else:
+                st = self._classes.get(error_name)
+                if st is None:
+                    st = self._classes[error_name] = _BreakerClass(
+                        cfg.window)
+                st.outcomes.append(True)
+                fails = sum(st.outcomes)
+                if st.state == "closed" and fails >= cfg.min_failures \
+                        and fails / len(st.outcomes) >= cfg.failure_rate:
+                    st.state = "open"
+                    st.opened_at = now
+                    st.trips += 1
+                    tripped.append((error_name, st.trips, fails))
+        if closed is not None:
+            from .obs.flight import FLIGHT
+            FLIGHT.record("probe", error_class=closed, outcome="closed",
+                          label=label)
+        for cls, trips, fails in tripped:
+            from .obs.flight import FLIGHT
+            from .obs.metrics import CIRCUIT_TRIPS
+            CIRCUIT_TRIPS.inc()
+            # the onset of a failure storm is exactly the window the
+            # flight ring should preserve: trip (and dump) per class
+            FLIGHT.trip(f"circuit:{cls}", error_class=cls, trips=trips,
+                        window_failures=fails, label=label)
+
+    def release(self, probe: Optional[str]) -> None:
+        """Free a granted probe slot without a health signal (the probe
+        admission was refused downstream before it could run)."""
+        if probe is None:
+            return
+        with self._lock:
+            st = self._classes.get(probe)
+            if st is not None and st.state == "half_open":
+                st.probes_out = max(0, st.probes_out - 1)
+
+    def state(self) -> dict[str, dict]:
+        """{error_class: {state, trips, window_failures}} snapshot."""
+        with self._lock:
+            return {cls: {"state": st.state, "trips": st.trips,
+                          "window_failures": sum(st.outcomes)}
+                    for cls, st in self._classes.items()}
+
+
 # -- fault injection ----------------------------------------------------------
 
 #: engine/harness fault points. Each is fired exactly once per logical
@@ -254,6 +495,14 @@ class FaultSpec:
     match: Optional[str] = None     # exact match on the fire() detail
     source: str = "manual"          # "config" specs replaced on reconfigure
     fired: int = field(default=0, compare=False)
+    #: per-spec probability RNG, seeded at arm time from (registry seed,
+    #: arm index, spec identity): the spec's firing-index set is a pure
+    #: function of the seed + arming order even when service threads hit
+    #: the point in nondeterministic interleavings (seeded chaos
+    #: campaigns rely on this). None until armed; draws under the
+    #: registry lock.
+    rng: Optional[random.Random] = field(default=None, compare=False,
+                                         repr=False)
 
     @classmethod
     def parse(cls, text: str, source: str = "manual") -> "FaultSpec":
@@ -287,45 +536,75 @@ class FaultRegistry:
 
     Engine/harness code calls :meth:`fire` at each point; the fast path
     (nothing armed) is one attribute read, so the hooks cost nothing in
-    production. Probability draws come from a seeded RNG in fire order, so
-    a run with probabilistic faults replays deterministically.
+    production. Probability draws come from PER-SPEC RNGs seeded at arm
+    time, so a spec's firing-index set is deterministic in that spec's
+    own firing order — chaos campaigns replay their schedules even when
+    concurrent service threads interleave the points nondeterministically.
+
+    Thread contract (audited for armed-under-live-traffic chaos runs):
+    every mutation of the spec list AND every iteration over it — firing,
+    certainty queries, arming, disarming, reconfiguring — happens under
+    ``_lock``; ``fire`` collects the triggered specs under the lock and
+    acts (sleeps/raises) outside it. The only unlocked read is the
+    nothing-armed fast path, a single attribute load of the list object
+    (atomic in CPython; a spec armed concurrently with that read is
+    simply not yet visible, same as arming one instruction later).
     """
 
     def __init__(self, seed: int = 0x5E51):
         self._specs: list[FaultSpec] = []
         self._lock = threading.Lock()
-        self._rng = random.Random(seed)
+        self._rng = random.Random(seed)     # fallback for unarmed specs
         self._seed = seed
+        self._armed_total = 0               # arm-order index for spec seeds
 
-    def arm(self, spec, **kwargs) -> FaultSpec:
+    def _seed_spec(self, spec: FaultSpec) -> None:
+        """Give the spec its deterministic RNG (under ``_lock``)."""
+        self._armed_total += 1
+        spec.rng = random.Random(
+            f"{self._seed}:{self._armed_total}:{spec.point}:"
+            f"{spec.action}:{spec.probability}:{spec.match}")
+
+    def arm(self, spec, **kwargs) -> FaultSpec:  # lint: thread-entry (campaign drivers arm while service threads fire)
         """Arm a FaultSpec (or parse a spec string). Returns the armed spec
         so callers can :meth:`disarm` it."""
         if isinstance(spec, str):
             spec = FaultSpec.parse(spec, **kwargs)
+        elif spec.point not in FAULT_POINTS:
+            # parse() validates spec strings; directly-constructed specs
+            # must not arm a point no engine layer will ever fire (a
+            # typo'd chaos campaign would otherwise "pass" as a no-op)
+            raise ValueError(f"unknown fault point {spec.point!r} "
+                             f"(expected one of {FAULT_POINTS})")
         with self._lock:
+            self._seed_spec(spec)
             self._specs.append(spec)
         return spec
 
-    def disarm(self, spec: FaultSpec) -> None:
+    def disarm(self, spec: FaultSpec) -> None:  # lint: thread-entry (campaign drivers disarm while service threads fire)
         with self._lock:
             if spec in self._specs:
                 self._specs.remove(spec)
 
-    def configure(self, texts: Iterable[str]) -> list[FaultSpec]:
+    def configure(self, texts: Iterable[str]) -> list[FaultSpec]:  # lint: thread-entry (sessions build on service/stream threads)
         """Install config-sourced specs, replacing any previous config batch
         (manually armed specs are untouched). Called by Session.__init__
         from ``EngineConfig.fault_points``."""
         parsed = [FaultSpec.parse(t, source="config") for t in texts if t]
         with self._lock:
             self._specs = [s for s in self._specs if s.source != "config"]
+            for s in parsed:
+                self._seed_spec(s)
             self._specs.extend(parsed)
         return parsed
 
-    def clear(self, point: Optional[str] = None) -> None:
+    def clear(self, point: Optional[str] = None) -> None:  # lint: thread-entry (campaign teardown races in-flight queries)
         with self._lock:
             self._specs = [] if point is None else \
                 [s for s in self._specs if s.point != point]
             self._rng = random.Random(self._seed)
+            if point is None:
+                self._armed_total = 0
 
     def specs(self) -> list[FaultSpec]:
         with self._lock:
@@ -342,7 +621,7 @@ class FaultRegistry:
                        and any(s.applies(d) for d in (detail, *aliases))
                        for s in self._specs)
 
-    def fire(self, point: str, detail: str = "", aliases: tuple = ()) -> None:
+    def fire(self, point: str, detail: str = "", aliases: tuple = ()) -> None:  # lint: thread-entry (every engine layer fires from service/staging threads)
         """Trigger any armed specs for ``point``. Raise-specs raise
         FaultError; delay-specs sleep; hang-specs sleep (default
         HANG_SECONDS) and then raise, so an abandoned deadline worker dies
@@ -356,7 +635,7 @@ class FaultRegistry:
                         not any(s.applies(d) for d in (detail, *aliases)):
                     continue
                 if s.probability < 1.0 and \
-                        self._rng.random() >= s.probability:
+                        (s.rng or self._rng).random() >= s.probability:
                     continue
                 s.fired += 1
                 triggered.append(s)

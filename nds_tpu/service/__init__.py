@@ -12,11 +12,18 @@ Public surface:
   deadlines, batching knobs.
 - :class:`Ticket` — one submitted query's async handle (``result()``).
 - typed failures: :class:`~nds_tpu.resilience.AdmissionRejected` (queue
-  full / closed) and :class:`~nds_tpu.resilience.DeadlineExceeded`
-  (per-tenant deadline expired while queued).
+  full / closed), :class:`~nds_tpu.resilience.DeadlineExceeded`
+  (per-tenant deadline expired while queued / lane watchdog abandon), and
+  :class:`~nds_tpu.resilience.CircuitOpen` (a per-error-class breaker is
+  shedding load until a half-open probe succeeds).
+
+Self-healing (all opt-in via ServiceConfig, exercised by ``nds_tpu/chaos``
+campaigns): circuit breaker at admission, bounded transient-failure retry
+budget, compiled-program quarantine, and a device-lane watchdog.
 """
-from ..resilience import AdmissionRejected, DeadlineExceeded
+from ..resilience import (AdmissionRejected, CircuitBreakerConfig,
+                          CircuitOpen, DeadlineExceeded)
 from .service import QueryService, ServiceConfig, Ticket
 
-__all__ = ["QueryService", "ServiceConfig", "Ticket",
-           "AdmissionRejected", "DeadlineExceeded"]
+__all__ = ["QueryService", "ServiceConfig", "Ticket", "AdmissionRejected",
+           "CircuitBreakerConfig", "CircuitOpen", "DeadlineExceeded"]

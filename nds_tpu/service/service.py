@@ -36,6 +36,15 @@ The device lane is ONE thread: the accelerator executes one program at a
 time anyway, and a single lane keeps the session executor's state
 single-writer (Session serializes statements on ``_sql_lock`` for safety,
 so even direct ``session.sql`` callers stay correct beside the service).
+
+**Self-healing** (opt-in via ServiceConfig; chaos campaigns in
+``nds_tpu/chaos`` exercise all four): a per-error-class circuit breaker
+at admission (typed ``CircuitOpen`` until a half-open probe succeeds), a
+bounded retry budget re-dispatching transient ticket failures off the
+device lane, quarantine of shared compiled programs that fail repeatedly
+(evicted + re-recorded instead of poisoning every adopter), and a
+device-lane watchdog that abandons a wedged dispatch and swaps fresh
+session locks the way the power runner recovers from a deadline kill.
 """
 from __future__ import annotations
 
@@ -50,7 +59,9 @@ from ..obs import metrics as _metrics
 from ..obs.flight import FLIGHT
 from ..obs.stats import ExecStats
 from ..obs.trace import TRACER
-from ..resilience import AdmissionRejected, Deadline, DeadlineExceeded
+from ..resilience import (AdmissionRejected, CircuitBreaker,
+                          CircuitBreakerConfig, CircuitOpen, Deadline,
+                          DeadlineExceeded, RetryPolicy, run_with_deadline)
 
 
 def _observe_phase(name: str, ms: float, tenant: str,
@@ -100,6 +111,27 @@ class ServiceConfig:
     batch_linger_ms: float = 0.0
     #: cross-client plan-cache entries (SQL text -> planned query); LRU
     plan_cache_entries: int = 512
+    # -- self-healing (chaos-hardened serving; all off by default so a
+    #    plain service behaves exactly as before) -------------------------
+    #: per-error-class circuit breaker at admission: a failure class
+    #: crossing its windowed rate trips, new submits fail typed
+    #: CircuitOpen, half-open probes test recovery (None = disabled)
+    breaker: Optional[CircuitBreakerConfig] = None
+    #: service-lifetime budget of transient ticket failures re-dispatched
+    #: off the device lane (requeued at the back of the ready queue)
+    #: instead of failing the client; 0 disables
+    retry_budget: int = 0
+    #: dispatch attempts per ticket while the retry budget lasts
+    ticket_attempts: int = 2
+    #: device-lane watchdog: a serial dispatch exceeding this wall budget
+    #: is ABANDONED mid-flight (fresh session locks swap in, the way
+    #: power.py recovers from a deadline kill) and the ticket fails typed
+    #: DeadlineExceeded while the lane serves its neighbors; 0 disables
+    dispatch_timeout_s: float = 0.0
+    #: strike shared compiled programs on batched-dispatch failures and
+    #: evict them after executor.QUARANTINE_STRIKES (re-recorded fresh on
+    #: next use instead of poisoning every adopter)
+    quarantine: bool = True
 
 
 class Ticket:
@@ -142,6 +174,11 @@ class Ticket:
         self.fp: Optional[str] = None
         self.pvalues: tuple = ()
         self.use_jax = True
+        #: serial dispatch attempts (the retry budget requeues transient
+        #: failures until this reaches ServiceConfig.ticket_attempts)
+        self.attempts = 0
+        #: error-class name this ticket probes for a half-open breaker
+        self._probe: Optional[str] = None
         self._done = threading.Event()
         self._result = None
         self._materialize = None
@@ -275,6 +312,11 @@ class QueryService:
         self._hold = False                # test/drain hook: park the lane
         self._running = False
         self._threads: list[threading.Thread] = []
+        cfg = self.config
+        self._breaker = CircuitBreaker(cfg.breaker) \
+            if cfg.breaker is not None else None
+        self._retry_budget_left = max(0, cfg.retry_budget)
+        self._retry_policy = RetryPolicy()   # classification only
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "QueryService":
@@ -350,17 +392,33 @@ class QueryService:
                 tenant, cfg.default_deadline_s)
         ticket = Ticket(query, label or self._auto_label(query), tenant,
                         Deadline(deadline_s), backend)
+        if self._breaker is not None:
+            # breaker gate BEFORE the pending set: a tripped class sheds
+            # load at the door (typed, fatal-until-probe) so the queue
+            # holds work that can actually succeed
+            try:
+                ticket._probe = self._breaker.admit(label=ticket.label)
+            except CircuitOpen as e:
+                _metrics.SERVICE_REJECTED.inc()
+                FLIGHT.record("reject", label=ticket.label, tenant=tenant,
+                              reason="circuit_open",
+                              error_class=e.error_class)
+                raise
         with self._cv:
             if not self._running:
                 _metrics.SERVICE_REJECTED.inc()
                 FLIGHT.record("reject", label=ticket.label, tenant=tenant,
                               reason="closed")
+                if self._breaker is not None:
+                    self._breaker.release(ticket._probe)
                 raise ServiceClosed("query service is not running")
             if self._pending >= cfg.max_pending:
                 _metrics.SERVICE_REJECTED.inc()
                 FLIGHT.record("reject", label=ticket.label, tenant=tenant,
                               reason="queue_full", depth=self._pending,
                               limit=cfg.max_pending)
+                if self._breaker is not None:
+                    self._breaker.release(ticket._probe)
                 raise AdmissionRejected(
                     f"admission queue full: {self._pending} pending >= "
                     f"max_pending {cfg.max_pending}",
@@ -586,6 +644,16 @@ class QueryService:
             else:
                 batch_error = None if outs is not None else "unavailable"
             if outs is None:
+                if batch_error != "unavailable" and self.config.quarantine:
+                    # a genuine failure THROUGH the shared program is a
+                    # quarantine strike: the same entry failing repeatedly
+                    # is evicted (shared + this session's local copy) so
+                    # the next sighting re-records fresh instead of every
+                    # adopter replaying the poison
+                    from ..engine.jax_backend.executor import \
+                        strike_shared_program
+                    if strike_shared_program(fp, reason=batch_error):
+                        jexec.evict_fp(fp)
                 for t, sp in zip(members, dspans):
                     sp.end(error=batch_error)
                     t.queue_wait_ms = None   # serial path re-measures
@@ -594,6 +662,10 @@ class QueryService:
                               via="serial_fallback")
                 return False
             exec_stats = dict(jexec.last_stats)
+            if self.config.quarantine:
+                from ..engine.jax_backend.executor import \
+                    absolve_shared_program
+                absolve_shared_program(fp)
         exec_ms = (time.perf_counter() - t0) * 1000.0
         for t, sp in zip(members, dspans):
             sp.end()
@@ -650,7 +722,13 @@ class QueryService:
     def _serve_serial(self, ticket: Ticket) -> None:
         """The normal Session path (record/adopt/replay, streaming,
         segmentation, host fallback) with the service's pre-built plan —
-        result + per-query stats captured atomically."""
+        result + per-query stats captured atomically. Self-healing rides
+        here: a dispatch outliving the lane watchdog is abandoned (fresh
+        session locks, the power.py recovery move) and fails typed while
+        neighbors proceed; a transient failure inside the retry budget
+        requeues off the lane instead of failing the client; repeated
+        failures through a shared program strike it toward quarantine."""
+        ticket.attempts += 1
         wait = ticket.mark_started()
         _metrics.SERVICE_QUEUE_WAIT_MS.inc(wait)
         t0 = time.perf_counter()
@@ -660,12 +738,22 @@ class QueryService:
             # the ticket root reaches down to parse/plan/morsel spans
             with TRACER.span("service/dispatch", cat="service",
                              parent=ticket.trace_id, label=ticket.label):
-                table, stats = self.session.service_run(
-                    ticket.query, backend=ticket.backend,
-                    label=ticket.label, plan=ticket.plan)
+                table, stats = self._dispatch_serial(ticket)
         except Exception as e:
+            if self.config.quarantine and ticket.fp is not None:
+                from ..engine.jax_backend.executor import \
+                    strike_shared_program
+                if strike_shared_program(ticket.fp,
+                                         reason=type(e).__name__):
+                    with self.session._sql_lock:
+                        self.session._jax_executor().evict_fp(ticket.fp)
+            if self._maybe_requeue(ticket, e):
+                return
             self._finish_ticket(ticket, error=e)
             return
+        if self.config.quarantine and ticket.fp is not None:
+            from ..engine.jax_backend.executor import absolve_shared_program
+            absolve_shared_program(ticket.fp)
         _observe_phase("service_exec_ms",
                        (time.perf_counter() - t0) * 1000.0,
                        ticket.tenant, ticket.template)
@@ -674,6 +762,61 @@ class QueryService:
         stats.queue_wait_ms = wait
         stats.trace_id = ticket.trace_id or None
         self._finish_ticket(ticket, result=table, stats=stats)
+
+    def _dispatch_serial(self, ticket: Ticket):
+        """One serial session dispatch, optionally under the device-lane
+        watchdog (ServiceConfig.dispatch_timeout_s): on overrun the stuck
+        worker is ABANDONED, the session swaps in fresh statement locks
+        (power.py's deadline-kill recovery), the trip is flight-dumped,
+        and typed DeadlineExceeded propagates — the lane moves on instead
+        of wedging every queued neighbor behind one hung dispatch."""
+        cfg = self.config
+
+        def run():
+            return self.session.service_run(
+                ticket.query, backend=ticket.backend,
+                label=ticket.label, plan=ticket.plan)
+
+        if cfg.dispatch_timeout_s <= 0:
+            return run()
+        try:
+            return run_with_deadline(run, cfg.dispatch_timeout_s,
+                                     label=f"dispatch:{ticket.label}")
+        except DeadlineExceeded:
+            self.session.abandon_inflight()
+            FLIGHT.trip("lane_watchdog", label=ticket.label,
+                        tenant=ticket.tenant,
+                        budget_s=cfg.dispatch_timeout_s)
+            raise
+
+    def _maybe_requeue(self, ticket: Ticket, error: BaseException) -> bool:
+        """Transient-failure re-dispatch off the device lane: requeue the
+        ticket at the back of the ready queue (no lane-blocking backoff)
+        while the per-ticket attempt cap, the service-lifetime retry
+        budget, and the ticket's own deadline all have room. Fatal classes
+        (DeadlineExceeded, CircuitOpen — see the resilience classification
+        table) never requeue."""
+        cfg = self.config
+        if cfg.retry_budget <= 0 or ticket.attempts >= cfg.ticket_attempts:
+            return False
+        if self._retry_policy.classify(error) != "transient":
+            return False
+        if ticket.deadline.expired():
+            return False
+        with self._cv:
+            if not self._running or self._retry_budget_left <= 0:
+                return False
+            self._retry_budget_left -= 1
+        _metrics.RETRY_BUDGET_SPENT.inc()
+        FLIGHT.record("retry", label=ticket.label, tenant=ticket.tenant,
+                      error=type(error).__name__, attempt=ticket.attempts,
+                      via="requeue")
+        ticket.queue_wait_ms = None   # the retried dispatch re-measures
+        ticket.begin_wait()
+        with self._cv:
+            self._ready.append(ticket)
+            self._cv.notify_all()
+        return True
 
     # -- shared bookkeeping --------------------------------------------------
     def _expire_if_late(self, ticket: Ticket, where: str) -> bool:
@@ -717,6 +860,13 @@ class QueryService:
             ticket.root.set(latency_ms=latency_ms)
             ticket.root.end(error=err_name)
             ticket.root = None
+        if self._breaker is not None:
+            # every terminal outcome teaches the breaker (probe slots are
+            # released here too); requeued tickets report only their
+            # final disposition
+            self._breaker.record(err_name, probe=ticket._probe,
+                                 label=ticket.label)
+            ticket._probe = None
         with self._cv:
             self._pending -= 1
             _metrics.SERVICE_QUEUE_DEPTH.set(self._pending)

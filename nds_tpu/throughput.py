@@ -302,7 +302,9 @@ def run_throughput(input_prefix: str, stream_dir: str, streams: list[int],
                    warmup: int = 0, decimal: str | None = None,
                    max_attempts: int | None = None,
                    stream_timeout: float | None = None,
-                   retry_backoff_s: float | None = None) -> float:
+                   retry_backoff_s: float | None = None,
+                   service_config=None,
+                   on_service=None) -> float:
     """Run the given streams concurrently; returns elapsed seconds.
 
     Elapsed is max(stream Power End) - min(stream Power Start) over the
@@ -321,6 +323,13 @@ def run_throughput(input_prefix: str, stream_dir: str, streams: list[int],
     outcomes are written to ``throughput_status.csv`` in the log dir.
     Permanent failures raise ThroughputError carrying the partial elapsed
     over the completed streams.
+
+    ``service_config`` (service mode only) overrides the round's
+    ServiceConfig — the lifecycle's chaos rounds arm the self-healing
+    knobs (circuit breaker, retry budget, lane watchdog) through it.
+    ``on_service`` (service mode only) is called with the LIVE
+    QueryService after start: the hook chaos/lifecycle instrumentation
+    uses to observe or arm a round while its clients are in flight.
     """
     from .config import EngineConfig
 
@@ -366,10 +375,13 @@ def run_throughput(input_prefix: str, stream_dir: str, streams: list[int],
         session = Session(config)
         from .power import setup_tables
         setup_tables(session, input_prefix, input_format)
-        svc_cfg = ServiceConfig(
-            max_pending=max(256, 8 * len(jobs)),
-            tenant_deadlines={}, default_deadline_s=0.0)
+        svc_cfg = service_config if service_config is not None \
+            else ServiceConfig(
+                max_pending=max(256, 8 * len(jobs)),
+                tenant_deadlines={}, default_deadline_s=0.0)
         with QueryService(session, svc_cfg) as service:
+            if on_service is not None:
+                on_service(service)
             def make_run(sid, sf, log, out):
                 def run():
                     # one tenant per stream: the registry's per-tenant

@@ -136,8 +136,27 @@ class WarehouseTable:
         if not os.path.exists(self.manifest_path):
             return {"table": self.name, "snapshots": [], "file_stats": {},
                     "enc_stats": {}}
-        with open(self.manifest_path) as f:
-            doc = json.load(f)
+        # manifests are replaced atomically (tmp + os.replace in
+        # _store_doc), so a reader should always see a complete doc — but
+        # chaos rounds run maintenance DML concurrently with service
+        # registrations on filesystems whose rename-vs-open atomicity is
+        # weaker than POSIX promises (overlayfs CI hosts), so a decode
+        # failure gets a bounded re-read before it becomes a hard error
+        # naming the file (not a bare JSONDecodeError three layers up)
+        last: Exception | None = None
+        for attempt in range(3):
+            if attempt:
+                time.sleep(0.05 * attempt)
+            try:
+                with open(self.manifest_path) as f:
+                    doc = json.load(f)
+                break
+            except json.JSONDecodeError as e:
+                last = e
+        else:
+            raise RuntimeError(
+                f"corrupt warehouse manifest {self.manifest_path} "
+                f"(persisted across re-reads): {last}") from last
         doc.setdefault("file_stats", {})
         doc.setdefault("enc_stats", {})
         return doc

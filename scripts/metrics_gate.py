@@ -50,6 +50,10 @@ STRICT_ZERO = (
     "replay_mismatches", "host_fallbacks", "query_failures",
     "prefetch_errors", "fault_point_firings", "service_rejected",
     "service_deadline_expired", "stream_restarts",
+    # chaos-hardened serving: a CLEAN workload must never trip a breaker
+    # or quarantine a program — movement here means the self-healing
+    # machinery fired on healthy traffic
+    "circuit_trips", "quarantined_programs",
 )
 
 #: report-only name suffixes: wall-clock and byte-volume metrics flake

@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""One-command scored lifecycle run (nds_tpu/lifecycle).
+
+Runs the reference's full deliverable — datagen -> load -> stream gen ->
+power -> throughput x2 -> maintenance x2 -> geometric-mean score — with
+per-phase checkpointing in <report_dir>/lifecycle_state.json. A crash
+(or injected fault) mid-run resumes from the last completed phase with
+--resume; the power phase resumes at query granularity through its
+flushed partial time log, and the score is always recomputed from the
+phase time logs, so a resumed run's score inputs are identical to an
+uninterrupted run's.
+
+Usage:
+  python scripts/run_lifecycle.py --sf 0.01 --report_dir ./lifecycle_sf001
+  python scripts/run_lifecycle.py --sf 0.01 --report_dir ./lifecycle_sf001 \
+      --resume                      # continue after a crash/kill
+  python scripts/run_lifecycle.py --sf 0.01 --chaos ...
+      # maintenance runs CONCURRENTLY with service-mode query streams
+      # under an armed fault campaign; flight dumps land per firing in
+      # <report_dir>/flight_round{1,2}
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="run_lifecycle.py", description=(
+        "single-command scored NDS lifecycle with per-phase "
+        "checkpointing and an optional chaos mode"))
+    p.add_argument("--sf", type=float, default=0.01,
+                   help="scale factor (0.01 = the CI-sized scored run)")
+    p.add_argument("--report_dir", default="./lifecycle_report")
+    p.add_argument("--streams", type=int, default=3,
+                   help="stream count (odd >= 3; stream 0 = power)")
+    p.add_argument("--resume", action="store_true",
+                   help="continue a crashed/killed run from its "
+                        "lifecycle_state.json checkpoint")
+    p.add_argument("--sub_queries", default=None,
+                   help="comma-separated query subset for every stream")
+    p.add_argument("--warmup", type=int, default=0)
+    p.add_argument("--backend", default=None, choices=["jax", "numpy"])
+    p.add_argument("--decimal", default=None, choices=["f64", "i64"])
+    p.add_argument("--use_decimal", action="store_true",
+                   help="load the warehouse with decimal columns")
+    p.add_argument("--datagen_parallel", type=int, default=2)
+    p.add_argument("--throughput_mode", default="thread",
+                   choices=["process", "thread", "service"])
+    p.add_argument("--stream_timeout", type=float, default=None)
+    p.add_argument("--phase_attempts", type=int, default=1,
+                   help="attempts per phase (retries count into the "
+                        "lifecycle_phase_retries metric)")
+    p.add_argument("--rngseed", type=int, default=None,
+                   help="stream-generation seed (default: load end stamp)")
+    p.add_argument("--chaos", action="store_true",
+                   help="run maintenance concurrently with service-mode "
+                        "query streams under an armed fault campaign")
+    p.add_argument("--chaos_points", default=None,
+                   help="comma list of fault points for --chaos (default "
+                        "device.put,jax.compile,jax.execute,query.run)")
+    p.add_argument("--chaos_times", type=int, default=2,
+                   help="firings cap per armed chaos spec")
+    p.add_argument("--json", default=None, metavar="PATH",
+                   help="also write the final {times, metric} block here")
+    a = p.parse_args(argv)
+
+    from nds_tpu.lifecycle import LifecycleConfig, LifecycleRunner
+
+    kwargs = dict(
+        scale_factor=a.sf, num_streams=a.streams, report_dir=a.report_dir,
+        datagen_parallel=a.datagen_parallel, use_decimal=a.use_decimal,
+        decimal=a.decimal, backend=a.backend,
+        sub_queries=a.sub_queries.split(",") if a.sub_queries else None,
+        warmup=a.warmup, rngseed=a.rngseed,
+        throughput_mode=a.throughput_mode, stream_timeout=a.stream_timeout,
+        phase_attempts=a.phase_attempts, chaos=a.chaos,
+        chaos_times_per_point=a.chaos_times)
+    if a.chaos_points:
+        kwargs["chaos_points"] = tuple(
+            x.strip() for x in a.chaos_points.split(",") if x.strip())
+    out = LifecycleRunner(LifecycleConfig(**kwargs)).run(resume=a.resume)
+    if a.json:
+        os.makedirs(os.path.dirname(a.json) or ".", exist_ok=True)
+        with open(a.json, "w") as f:
+            json.dump(out, f, indent=2, sort_keys=True)
+    print(json.dumps(out, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -179,6 +179,41 @@ def print_flight(events: list[dict], top: int) -> None:
             print(f"{t[:16]:<16} {r['complete']:>9} {r['reject']:>7} "
                   f"{r['expire']:>7} {r['error']:>6} {mean:>9.1f} "
                   f"{r['max_ms']:>9.1f}")
+    # self-healing / lifecycle vocabulary (chaos-hardened serving): the
+    # old event set prints exactly as before — this block only appears
+    # when the new events are present in the dump
+    trips: dict[str, int] = {}
+    probes: dict[str, int] = {}
+    quarantines = []
+    phases = []
+    for e in events:
+        if e["event"] == "trip":
+            r = e.get("reason", "?")
+            trips[r] = trips.get(r, 0) + 1
+        elif e["event"] == "probe":
+            key = f"{e.get('error_class', '?')}" + \
+                ("/closed" if e.get("outcome") == "closed" else "")
+            probes[key] = probes.get(key, 0) + 1
+        elif e["event"] == "quarantine":
+            quarantines.append(e)
+        elif e["event"] == "lifecycle_phase":
+            phases.append(e)
+    if trips or probes or quarantines:
+        print("\nself-healing:")
+        for r in sorted(trips, key=lambda r: -trips[r]):
+            print(f"  trip {r:<24} x{trips[r]}")
+        for k in sorted(probes):
+            print(f"  probe {k:<23} x{probes[k]}")
+        for e in quarantines:
+            print(f"  quarantine fp={e.get('fp', '?')} "
+                  f"strikes={e.get('strikes', '?')} "
+                  f"reason={e.get('reason', '?')}")
+    if phases:
+        print("\nlifecycle phases:")
+        for e in phases:
+            extra = f" ({e['elapsed_s']}s)" if e.get("elapsed_s") else ""
+            print(f"  {e['t_ms']:>10.1f} ms  {e.get('phase', '?'):<18} "
+                  f"{e.get('status', '?')}{extra}")
     done = sorted((e for e in events if e["event"] == "complete"
                    and e.get("latency_ms") is not None),
                   key=lambda e: -e["latency_ms"])[:top]

@@ -1,0 +1,245 @@
+"""Crash-resumable scored lifecycle (nds_tpu/lifecycle).
+
+Fast tests drive the checkpoint/resume/score machinery through a stub
+runner writing deterministic phase logs (phase bodies are the ONLY thing
+stubbed — state transitions, retries, scraping, and scoring are real);
+the slow tests run the real thing end to end at SF0.001, including a
+mid-power SIGKILL + --resume and the chaos round."""
+import csv
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from nds_tpu.bench import get_perf_metric
+from nds_tpu.lifecycle import (PHASES, LifecycleConfig, LifecycleRunner,
+                               LifecycleStateError)
+from nds_tpu.obs.metrics import METRICS
+from nds_tpu.power import _write_time_log
+from nds_tpu.throughput import stream_log_path
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: the stub phases' deterministic timing-log contents (ms epochs)
+POWER_ROWS = [("query1", 1000, 3000, 2000), ("query3", 3000, 4000, 1000)]
+POWER_SPAN = (1000, 5000)           # -> Power Test Time 4.0 s
+STREAM_SPAN = (1000, 11000)         # -> throughput elapsed 10.0 s
+DM_ROWS = [("LF_CR", 0, 500, 500), ("DF_I", 500, 750, 250)]  # 0.75 s
+LOAD_SECONDS = 12.345               # -> 12.4 after round-up
+
+
+class StubRunner(LifecycleRunner):
+    """Real checkpoint/score machinery over deterministic phase bodies.
+    ``fail_phases`` maps phase name -> number of times it raises before
+    succeeding (the injected mid-lifecycle crash)."""
+
+    def __init__(self, config, fail_phases=None):
+        super().__init__(config)
+        self.calls = []
+        self.fail_phases = dict(fail_phases or {})
+
+    def _mark(self, name):
+        self.calls.append(name)
+        left = self.fail_phases.get(name, 0)
+        if left > 0:
+            self.fail_phases[name] = left - 1
+            raise RuntimeError(f"injected failure in {name}")
+
+    def _phase_datagen(self):
+        self._mark("datagen")
+
+    def _phase_load(self):
+        self._mark("load")
+        with open(self._load_report(), "w") as f:
+            f.write(f"Load Test Time: {LOAD_SECONDS} seconds\n"
+                    "RNGSEED used: 123\n")
+
+    def _phase_streams(self):
+        self._mark("streams")
+
+    def _phase_power(self):
+        self._mark("power")
+        _write_time_log(self._power_log(), POWER_SPAN[0], POWER_ROWS,
+                        POWER_SPAN[1])
+
+    def _phase_throughput(self, rnd):
+        self._mark(f"throughput{rnd}")
+        from nds_tpu.bench import get_stream_range
+        for s in get_stream_range(self.cfg.num_streams, rnd):
+            _write_time_log(stream_log_path(self.cfg.report_dir, s),
+                            STREAM_SPAN[0], POWER_ROWS, STREAM_SPAN[1])
+
+    def _phase_maintenance(self, rnd):
+        self._mark(f"maintenance{rnd}")
+        from nds_tpu.bench import get_stream_range
+        for s in get_stream_range(self.cfg.num_streams, rnd):
+            with open(self._dm_log(s), "w", newline="") as f:
+                w = csv.writer(f)
+                w.writerow(["query", "start_time", "end_time", "time"])
+                w.writerow(["Maintenance Start Time", 0, "", ""])
+                for r in DM_ROWS:
+                    w.writerow(r)
+                w.writerow(["Maintenance End Time", 750, "", ""])
+
+
+def cfg_for(tmp_path, name="run", **kw):
+    kw.setdefault("scale_factor", 100.0)    # big SF: a nonzero stub metric
+    kw.setdefault("num_streams", 3)
+    return LifecycleConfig(report_dir=str(tmp_path / name), **kw)
+
+
+EXPECTED_TIMES = {"load": 12.4, "power": 4.0, "throughput1": 10.0,
+                  "throughput2": 10.0, "maintenance1": 0.8,
+                  "maintenance2": 0.8}
+
+
+def test_stub_run_scores_and_checkpoints(tmp_path):
+    r = StubRunner(cfg_for(tmp_path))
+    out = r.run()
+    assert r.calls == list(PHASES)
+    assert out["times"] == EXPECTED_TIMES
+    assert out["metric"] == get_perf_metric(
+        100.0, 3, 12.4, 4.0, 10.0, 10.0, 0.8, 0.8) > 0
+    state = json.load(open(r.state_path))
+    assert all(state["phases"][p]["status"] == "done" for p in PHASES)
+    assert state["score"]["perf_metric"] == out["metric"]
+    assert os.path.exists(os.path.join(r.cfg.report_dir, "metrics.csv"))
+
+
+def test_crash_then_resume_identical_score_inputs(tmp_path):
+    # uninterrupted reference
+    ref = StubRunner(cfg_for(tmp_path, "ref")).run()
+    # crashed run: throughput1 raises once, phase_attempts=1 -> the run
+    # dies mid-lifecycle exactly like a SIGKILL after the power phase
+    cfg = cfg_for(tmp_path, "crash")
+    r1 = StubRunner(cfg, fail_phases={"throughput1": 1})
+    with pytest.raises(RuntimeError, match="injected failure"):
+        r1.run()
+    state = json.load(open(r1.state_path))
+    for p in ("datagen", "load", "streams", "power"):
+        assert state["phases"][p]["status"] == "done"
+    assert state["phases"]["throughput1"]["status"] == "failed"
+    # resume with a fresh runner (new process, no memory of the first)
+    r2 = StubRunner(cfg_for(tmp_path, "crash"))
+    out = r2.run(resume=True)
+    # completed phases did NOT re-run; the interrupted one did
+    assert r2.calls == ["throughput1", "maintenance1", "throughput2",
+                        "maintenance2"]
+    # the acceptance bar: identical per-phase timing-log inputs to the
+    # score, and therefore the identical score
+    assert out["times"] == ref["times"]
+    assert out["metric"] == ref["metric"]
+
+
+def test_existing_state_requires_resume(tmp_path):
+    cfg = cfg_for(tmp_path)
+    StubRunner(cfg).run()
+    with pytest.raises(LifecycleStateError, match="--resume"):
+        StubRunner(cfg_for(tmp_path)).run()
+
+
+def test_incompatible_config_refused_on_resume(tmp_path):
+    StubRunner(cfg_for(tmp_path)).run()
+    other = cfg_for(tmp_path, sub_queries=["query1"])
+    with pytest.raises(LifecycleStateError, match="incompatible"):
+        StubRunner(other).run(resume=True)
+
+
+def test_phase_retry_counts_metric(tmp_path):
+    before = METRICS.snapshot()
+    cfg = cfg_for(tmp_path, phase_attempts=2)
+    r = StubRunner(cfg, fail_phases={"power": 1})
+    out = r.run()
+    assert out["times"] == EXPECTED_TIMES
+    assert METRICS.delta(before).get("lifecycle_phase_retries", 0) == 1
+    state = json.load(open(r.state_path))
+    assert state["phases"]["power"]["attempts"] == 2
+
+
+# -- the real thing (slow) ----------------------------------------------------
+
+LIFECYCLE_CLI = os.path.join(REPO, "scripts", "run_lifecycle.py")
+SUBSET = "query1,query3"
+
+
+def _cli(report_dir, *extra):
+    return [sys.executable, LIFECYCLE_CLI, "--sf", "0.001",
+            "--report_dir", report_dir, "--streams", "3",
+            "--sub_queries", SUBSET, "--throughput_mode", "thread",
+            "--rngseed", "777", "--datagen_parallel", "2", *extra]
+
+
+@pytest.mark.slow
+def test_real_lifecycle_kill_mid_power_then_resume(tmp_path):
+    """SIGKILL the run once the power phase has flushed at least one
+    query, then --resume: the run completes, the pre-kill power rows are
+    preserved verbatim, every query is timed exactly once, and the score
+    comes out of the combined logs."""
+    rd = str(tmp_path / "life")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.Popen(_cli(rd), env=env,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    power_log = os.path.join(rd, "power.csv")
+    deadline = time.time() + 900
+    killed = False
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            break       # finished before we could kill: still a pass
+        if os.path.exists(power_log):
+            try:
+                rows = [r for r in csv.reader(open(power_log))
+                        if r and r[0].startswith("query")
+                        and r[0] != "query"]
+            except OSError:
+                rows = []
+            if rows:
+                proc.send_signal(signal.SIGKILL)
+                proc.wait()
+                killed = True
+                break
+        time.sleep(0.5)
+    assert killed or proc.poll() == 0
+    pre_kill = []
+    if killed:
+        pre_kill = [r for r in csv.reader(open(power_log))
+                    if r and r[0].startswith("query") and r[0] != "query"]
+    out = subprocess.run(_cli(rd, "--resume"), env=env,
+                         capture_output=True, text=True, timeout=1800)
+    assert out.returncode == 0, out.stderr[-2000:]
+    final = [r for r in csv.reader(open(power_log))
+             if r and r[0].startswith("query") and r[0] != "query"]
+    # pre-kill measurements preserved verbatim; every query exactly once
+    assert final[:len(pre_kill)] == pre_kill
+    assert sorted(r[0] for r in final) == sorted(SUBSET.split(","))
+    state = json.load(open(os.path.join(rd, "lifecycle_state.json")))
+    assert all(state["phases"][p]["status"] == "done" for p in PHASES)
+    assert "perf_metric" in state["score"]
+    assert os.path.exists(os.path.join(rd, "metrics.csv"))
+
+
+@pytest.mark.slow
+def test_real_lifecycle_chaos_round(tmp_path):
+    """Chaos mode for real: maintenance concurrently with service-mode
+    streams under an armed campaign, flight dumps per firing, and the
+    run still scores."""
+    from nds_tpu.lifecycle import run_lifecycle
+
+    cfg = LifecycleConfig(
+        scale_factor=0.001, num_streams=3,
+        report_dir=str(tmp_path / "chaos"),
+        sub_queries=SUBSET.split(","), rngseed=777,
+        chaos=True, chaos_times_per_point=1, phase_attempts=2)
+    out = run_lifecycle(cfg)
+    assert set(out["times"]) == {"load", "power", "throughput1",
+                                 "throughput2", "maintenance1",
+                                 "maintenance2"}
+    state = json.load(open(os.path.join(cfg.report_dir,
+                                        "lifecycle_state.json")))
+    assert all(state["phases"][p]["status"] == "done" for p in PHASES)
+    fired = state["phases"]["throughput1"].get("chaos_fired", [])
+    assert {f["point"] for f in fired} == set(cfg.chaos_points)

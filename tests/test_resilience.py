@@ -282,18 +282,27 @@ def test_power_resume_skips_completed_queries(tiny_env, tmp_path):
     log without re-running completed queries."""
     inp, streams, _ = tiny_env
     log = str(tmp_path / "time.csv")
-    # simulate an interrupted run: query1 recorded, no sentinel end rows
+    # simulate an interrupted run: query1 recorded, no sentinel end rows,
+    # and query1's JSON summary already on disk — a resumed run re-enters
+    # its OWN summary folder (the non-empty-folder refusal only applies
+    # to fresh runs; stale-run poisoning is what it guards against)
     _write_time_log(log, 111, [("query1", 111, 222, 111)], None)
     json_dir = str(tmp_path / "json")
+    os.makedirs(os.path.join(json_dir, "power"))
+    with open(os.path.join(json_dir, "power", "power-query1-0.json"),
+              "w") as f:
+        f.write('{"queryStatus": ["Completed"]}')
     rows = run_query_stream(inp, os.path.join(streams, "query_0.sql"),
                             log, backend="numpy",
                             json_summary_folder=json_dir, resume=True)
     assert rows[0] == ("query1", 111, 222, 111)   # preserved, not re-run
     assert [r[0] for r in rows] == ["query1", "query3"]
-    # only the remaining query produced a summary
+    # the pre-kill summary is preserved and only the remaining query
+    # produced a new one
     ran = {os.path.basename(p).split("-")[1]
-           for p in glob.glob(os.path.join(json_dir, "*.json"))}
-    assert ran == {"query3"}
+           for p in glob.glob(os.path.join(json_dir, "**", "*.json"),
+                              recursive=True)}
+    assert ran == {"query1", "query3"}
     with open(log) as f:
         rows_csv = list(csv.reader(f))
     labels = [r[0] for r in rows_csv]

@@ -55,6 +55,14 @@
 #                thread hops, flight-recorder ring overflow and fault-
 #                triggered dumps; the 100-client open-loop run carries
 #                the slow marker and runs in the full `test` stage
+#   cache      - semantic result cache tier-1: exact-tier hit/miss/
+#                generation/TTL semantics, the subsumption proof battery
+#                (accepts + adversarial rejects), the IVM differential
+#                fast slice (3 LF_*/DF_* functions at SF0.001, cached-
+#                updated vs cold-recompute bit-identical), and the
+#                service admission wiring (tests/test_result_cache.py);
+#                the full 11-function sweep carries the slow marker and
+#                runs in the full `test` stage
 #   chaos      - chaos-hardened serving: circuit breaker / retry budget /
 #                program quarantine / lane watchdog under REAL injected
 #                faults, a seeded ~8-client campaign against the live
@@ -160,6 +168,15 @@ stage_service() {
         tests/test_obs_service.py -q -m 'not slow')
 }
 
+stage_cache() {
+    # semantic result cache: every tier must be bit-identical to
+    # recompute — exact hits, re-filtered coarser aggregates after a
+    # containment proof, and partials updated in place across LF_*/DF_*
+    # maintenance deltas (counts-based pins; wall times never gate here)
+    (cd "$REPO" && python -m pytest tests/test_result_cache.py \
+        -q -m 'not slow')
+}
+
 stage_chaos() {
     # resilience as a verified property of the WHOLE stack: typed
     # degradation, bit-stable completions, and self-healing (breaker,
@@ -201,16 +218,16 @@ run_stage() {
 }
 
 case "${1:-all}" in
-    native|resilience|static|planner|encoded|kernels|mesh|service|chaos|metrics_gate|test|bench)
+    native|resilience|static|planner|encoded|kernels|mesh|service|cache|chaos|metrics_gate|test|bench)
         run_stage "$1" ;;
     all)
         total0=$SECONDS
         for s in native resilience static planner encoded kernels mesh \
-                 service chaos metrics_gate test bench; do
+                 service cache chaos metrics_gate test bench; do
             run_stage "$s"
         done
         echo "stage all: $((SECONDS - total0))s" ;;
-    --list)     echo "native resilience static planner encoded kernels mesh service chaos metrics_gate test bench all" ;;
-    *) echo "usage: run_ci.sh [native|resilience|static|planner|encoded|kernels|mesh|service|chaos|metrics_gate|test|bench|all|--list]" >&2
+    --list)     echo "native resilience static planner encoded kernels mesh service cache chaos metrics_gate test bench all" ;;
+    *) echo "usage: run_ci.sh [native|resilience|static|planner|encoded|kernels|mesh|service|cache|chaos|metrics_gate|test|bench|all|--list]" >&2
        exit 2 ;;
 esac

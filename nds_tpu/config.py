@@ -166,6 +166,31 @@ class EngineConfig:
     # from the warehouse; here eviction forces a re-upload on next use).
     # 0 disables eviction.
     scan_budget_gb: float = 10.0
+    # -- semantic result cache (engine/result_cache.py) --------------------
+    # cross-client result reuse keyed by parameterized-plan fingerprint +
+    # parameter vector: a repeat dashboard load is answered from the cache
+    # without touching the planner or the device. Invalidated by per-table
+    # catalog generations (Session.table_generation) and the optional TTL;
+    # bit-identical to recompute by construction (the entry IS a previous
+    # execution's result). All tiers are OPT-IN — the default engine
+    # behaves exactly as before. Property: nds.tpu.result_cache; the
+    # query service reads these when ServiceConfig.result_cache is unset.
+    result_cache: bool = False
+    # cached entries before LRU eviction (capacity bound)
+    result_cache_entries: int = 256
+    # seconds before a cached entry expires (0 = no TTL)
+    result_cache_ttl_s: float = 0.0
+    # subsumption tier: answer a provably-narrower filter/date-window over
+    # the same group keys by re-filtering a cached coarser aggregate on
+    # host (the PR 4 verifier fingerprint machinery is the proof engine);
+    # falls back to normal execution on any proof failure
+    result_cache_subsumption: bool = False
+    # incremental view maintenance: entries for decomposable aggregates
+    # keep the mergeable partial state streaming._decompose produces, and
+    # LF_*/DF_* maintenance deltas UPDATE those partials (merge inserted-
+    # row partials; recompute only delta-touched groups for deletes)
+    # instead of invalidating — dashboards stay warm across maintenance
+    result_cache_ivm: bool = False
     # -- resilience (nds_tpu/resilience.py) --------------------------------
     # per-query wall-clock budget in seconds; an overrun abandons the query
     # and records Failed (DeadlineExceeded). 0 = unbounded.

@@ -11,6 +11,7 @@ nds_power.py / nds_transcode.py) with a TPU-first design:
 - multi-chip scaling via jax.sharding over a Mesh with psum/all_gather/
   all_to_all collectives (see nds_tpu.parallel), not executor shuffles.
 """
+from .result_cache import ResultCache, ResultCacheConfig
 from .session import Session
 
-__all__ = ["Session"]
+__all__ = ["Session", "ResultCache", "ResultCacheConfig"]

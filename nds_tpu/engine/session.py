@@ -131,6 +131,23 @@ class Session:
         # catalog generation: bumped on any (re-)registration so the device
         # executor's scan cache and compiled plans never serve stale data
         self._generation = 0
+        # per-table generations beside the global counter: the semantic
+        # result cache invalidates entries by the generations of the base
+        # tables a plan actually touches, so re-registering table A never
+        # evicts cached results over table B (the global counter stays the
+        # stream-cache/compiled-plan key — those embed cross-table state)
+        self._table_generations: dict[str, int] = {}
+        # source-content fingerprints for warehouse registrations: lets
+        # Warehouse.register_all skip tables whose snapshot files did not
+        # change (a maintenance INSERT into store_sales must not bump the
+        # other 23 tables' generations and cold their caches)
+        self._source_files: dict[str, tuple] = {}
+        # maintenance-delta subscribers (result_cache IVM): called as
+        # fn(table, inserts=arrow|None, deletes=arrow|None) AFTER the
+        # warehouse commit re-registers, under the statement lock
+        self._delta_subscribers: list = []
+        # optional attached semantic result cache (engine/result_cache.py)
+        self.result_cache = None
         self._jax_exec = None
         self._jax_exec_gen = -1
         # out-of-core: per-query streaming state (rewritten plan + compiled
@@ -230,6 +247,39 @@ class Session:
         self._unique_cols[name] = frozenset(
             c for c in unique_cols if c in have)
 
+    def _bump_generation(self, name: str) -> None:
+        """One (re-)registration or drop of `name`: the global generation
+        moves (stream cache / compiled plans / executor scan cache) AND the
+        table's own generation moves (result-cache invalidation scope)."""
+        self._generation += 1
+        self._table_generations[name] = \
+            self._table_generations.get(name, 0) + 1
+
+    def table_generation(self, name: str) -> int:
+        """Current per-table catalog generation (0 = never registered)."""
+        return self._table_generations.get(name, 0)
+
+    def attach_result_cache(self, cache) -> None:
+        """Bind a semantic ResultCache (engine/result_cache.py): the cache
+        reads per-table generations for invalidation and subscribes to
+        maintenance deltas for incremental view maintenance. Idempotent."""
+        self.result_cache = cache
+        if cache.apply_delta not in self._delta_subscribers:
+            self._delta_subscribers.append(cache.apply_delta)
+
+    def _publish_table_delta(self, table: str, inserts=None,
+                             deletes=None) -> None:
+        """Hand one maintenance statement's row delta to every subscriber
+        (called after the warehouse commit re-registered the table, so
+        subscribers see the post-statement catalog generations). Subscriber
+        failures degrade to invalidation inside the subscriber — a delta
+        must never fail the DML statement that produced it."""
+        if not self._delta_subscribers or (inserts is None
+                                           and deletes is None):
+            return
+        for fn in list(self._delta_subscribers):
+            fn(table, inserts=inserts, deletes=deletes)
+
     # -- registration -------------------------------------------------------
     def register_arrow(self, name: str, table: pa.Table,
                        est_rows: Optional[int] = None,
@@ -252,7 +302,7 @@ class Session:
             lambda col, t=table, dec=dec: \
             arrow_bridge.column_enc_stat(t.column(col), dec)
         self._drop_cached(name)
-        self._generation += 1
+        self._bump_generation(name)
 
     def register_parquet(self, name: str, path: str,
                          est_rows: Optional[int] = None,
@@ -297,7 +347,7 @@ class Session:
             lambda col, ds=dataset, dec=dec: arrow_bridge.column_enc_stat(
                 ds.to_table(columns=[col]).column(col), dec)
         self._drop_cached(name)
-        self._generation += 1
+        self._bump_generation(name)
 
     def register_csv(self, name: str, path: str, schema: pa.Schema,
                      est_rows: Optional[int] = None,
@@ -344,7 +394,7 @@ class Session:
                                           convert_options=convert)
         self._batch_sources[name] = batches
         self._drop_cached(name)
-        self._generation += 1
+        self._bump_generation(name)
 
     def register_view(self, name: str, table: Table,
                       dtypes: Optional[list[str]] = None,
@@ -361,7 +411,7 @@ class Session:
             lambda col, t=table: _engine_col_enc_stat(t, col)
         self._drop_cached(name)
         self._cache[(name, None)] = table
-        self._generation += 1
+        self._bump_generation(name)
 
     def drop(self, name: str) -> None:
         self._schemas.pop(name, None)
@@ -372,7 +422,8 @@ class Session:
         self._drop_cached(name)
         self._est_rows.pop(name, None)
         self._unique_cols.pop(name, None)
-        self._generation += 1
+        self._source_files.pop(name, None)
+        self._bump_generation(name)
 
     def table_names(self) -> list[str]:
         return list(self._schemas)
@@ -1235,6 +1286,10 @@ class Session:
         data = arrow_bridge.to_arrow(rows).rename_columns(target_names)
         self.warehouse.table(stmt.table).insert(data)
         self.warehouse.register_all(self)  # refresh snapshot binding
+        # LF_* delta publication: the inserted rows ARE the delta —
+        # subscribers (result-cache IVM) merge per-group partials from
+        # them instead of recomputing the warm dashboards they feed
+        self._publish_table_delta(stmt.table, inserts=data)
 
     def _delete(self, stmt, backend: Optional[str]) -> None:
         """DELETE FROM <table> WHERE <pred>: rewrite warehouse files keeping
@@ -1248,9 +1303,33 @@ class Session:
         from ..sql import parse_sql
 
         wt = self.warehouse.table(stmt.table)
+        # DF_* delta publication: wrap the keep filter so the rows each
+        # batch DROPS are captured as the statement's delete delta
+        # (subscribers recompute only delta-touched groups); capture only
+        # when someone is listening — the rows are otherwise dead weight
+        deleted_parts: list = []
+
+        def capture_deletes(t: pa.Table, keep):
+            if self._delta_subscribers:
+                import pyarrow.compute as pc
+                dropped = t.filter(pc.invert(pa.array(keep,
+                                                      type=pa.bool_())))
+                if dropped.num_rows:
+                    deleted_parts.append(dropped)
+            return keep
+
+        def publish_deletes():
+            if deleted_parts:
+                self._publish_table_delta(
+                    stmt.table,
+                    deletes=pa.concat_tables(deleted_parts,
+                                             promote_options="permissive"))
+
         if stmt.where is None:
-            wt.delete_where(lambda t: pa.array([False] * t.num_rows))
+            wt.delete_where(lambda t: capture_deletes(
+                t, pa.array([False] * t.num_rows)))
             self.warehouse.register_all(self)
+            publish_deletes()
             return
 
         def _references_target(node) -> bool:
@@ -1295,7 +1374,7 @@ class Session:
             deleted = np.zeros(t.num_rows, dtype=bool)
             ids = np.asarray(hit.columns[0].data, dtype=np.int64)
             deleted[ids[hit.columns[0].validity]] = True
-            return pa.array(~deleted)
+            return capture_deletes(t, pa.array(~deleted))
 
         # skip the (subquery-evaluating) stats analysis entirely when the
         # warehouse predates file stats — nothing could prune
@@ -1305,6 +1384,7 @@ class Session:
         wt.delete_where(keep_filter, batch_rows=batch_rows,
                         part_prune=part_prune, stats_prune=stats_prune)
         self.warehouse.register_all(self)
+        publish_deletes()
 
     def _stats_prune(self, table: str, where, _references_target):
         """File-stats pruning rule for a DELETE: if some AND-conjunct is

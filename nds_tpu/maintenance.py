@@ -117,6 +117,8 @@ def run_maintenance(warehouse_path: str, refresh_dir: str, time_log: str,
             variants = [sql]
         report = BenchReport(config, app_name=f"NDS-TPU maintenance {func}")
         start = int(time.time() * 1000)
+        from .obs.metrics import METRICS
+        before = METRICS.snapshot()
 
         def run_all(variants=variants):
             for v in variants:
@@ -125,10 +127,16 @@ def run_maintenance(warehouse_path: str, refresh_dir: str, time_log: str,
         elapsed = report.summary["queryTimes"][-1]
         status = report.summary["queryStatus"][-1]
         rows.append((func, start, start + elapsed, elapsed))
+        delta = METRICS.delta(before)
         # the chaos-mode post-mortem view: refresh functions interleaved
-        # with live service admissions/dispatches in one flight ring
+        # with live service admissions/dispatches in one flight ring —
+        # including how the semantic result cache absorbed this function's
+        # row delta (updated-in-place entries vs invalidated ones)
         FLIGHT.record("maintenance", func=func, status=status, ms=elapsed,
-                      variants=len(variants))
+                      variants=len(variants),
+                      ivm_updates=delta.get("result_cache_ivm_updates"),
+                      cache_invalidations=delta.get(
+                          "result_cache_invalidations"))
         print(f"{func}: {status} in {elapsed} ms", flush=True)
         if json_summary_folder:
             report.write_summary(

@@ -611,6 +611,30 @@ QUARANTINED_PROGRAMS = METRICS.counter(
 LIFECYCLE_PHASE_RETRIES = METRICS.counter(
     "lifecycle_phase_retries", "scored-lifecycle phases re-run after a "
     "failure (lifecycle.LifecycleRunner phase_attempts)")
+# Semantic result cache (engine/result_cache.py): cross-client result
+# reuse keyed by parameterized-plan fingerprint + parameter vector, with
+# subsumption proofs and incremental view maintenance from LF_*/DF_*
+# deltas — all opt-in, all exactly zero when the cache is disabled (the
+# metrics gate pins result_cache_hits strict-zero on its clean workload)
+RESULT_CACHE_HITS = METRICS.counter(
+    "result_cache_hits", "queries answered from the semantic result "
+    "cache's exact tier (no planning, no device dispatch)")
+RESULT_CACHE_MISSES = METRICS.counter(
+    "result_cache_misses", "result-cache lookups that fell through to "
+    "normal execution (cold text, stale generation, expired TTL, or no "
+    "provable subsumption)")
+RESULT_CACHE_SUBSUMPTION_HITS = METRICS.counter(
+    "result_cache_subsumption_hits", "queries answered by re-filtering a "
+    "cached coarser aggregate after a containment proof (provably-"
+    "narrower filter over the same group keys — no scan, no upload)")
+RESULT_CACHE_IVM_UPDATES = METRICS.counter(
+    "result_cache_ivm_updates", "cached aggregate entries updated in "
+    "place from a maintenance insert/delete delta (mergeable partial "
+    "state merged/recomputed instead of invalidated)")
+RESULT_CACHE_INVALIDATIONS = METRICS.counter(
+    "result_cache_invalidations", "result-cache entries dropped for "
+    "staleness (table generation moved, TTL expired, or a delta the "
+    "entry could not absorb)")
 
 # Service latency distributions (histogram families): the base series
 # aggregates every query; the service also records per-(tenant, template)

@@ -21,9 +21,11 @@ Self-healing (all opt-in via ServiceConfig, exercised by ``nds_tpu/chaos``
 campaigns): circuit breaker at admission, bounded transient-failure retry
 budget, compiled-program quarantine, and a device-lane watchdog.
 """
+from ..engine.result_cache import ResultCache, ResultCacheConfig
 from ..resilience import (AdmissionRejected, CircuitBreakerConfig,
                           CircuitOpen, DeadlineExceeded)
 from .service import QueryService, ServiceConfig, Ticket
 
 __all__ = ["QueryService", "ServiceConfig", "Ticket", "AdmissionRejected",
-           "CircuitBreakerConfig", "CircuitOpen", "DeadlineExceeded"]
+           "CircuitBreakerConfig", "CircuitOpen", "DeadlineExceeded",
+           "ResultCache", "ResultCacheConfig"]

@@ -37,6 +37,14 @@ time anyway, and a single lane keeps the session executor's state
 single-writer (Session serializes statements on ``_sql_lock`` for safety,
 so even direct ``session.sql`` callers stay correct beside the service).
 
+- **Semantic result cache** (opt-in, ``ServiceConfig.result_cache`` /
+  ``EngineConfig.result_cache``): repeat texts are answered at ADMISSION
+  from the cross-client result cache (no planner thread, no device
+  lane); first-sighting texts of a cached template and provably-narrower
+  filters are answered at the planner stage (exact-by-fingerprint and
+  subsumption tiers of ``engine/result_cache.py``); maintenance deltas
+  UPDATE cached mergeable aggregates in place instead of invalidating.
+
 **Self-healing** (opt-in via ServiceConfig; chaos campaigns in
 ``nds_tpu/chaos`` exercise all four): a per-error-class circuit breaker
 at admission (typed ``CircuitOpen`` until a half-open probe succeeds), a
@@ -132,6 +140,13 @@ class ServiceConfig:
     #: evict them after executor.QUARANTINE_STRIKES (re-recorded fresh on
     #: next use instead of poisoning every adopter)
     quarantine: bool = True
+    #: semantic result cache (engine/result_cache.ResultCacheConfig):
+    #: exact cross-client reuse at ADMISSION (a repeat dashboard text
+    #: touches neither planner thread nor device lane), subsumption
+    #: proofs at the planner stage, and IVM across maintenance deltas.
+    #: None falls back to the session's EngineConfig.result_cache flag
+    #: (still-None/off = no cache, the pre-cache service exactly).
+    result_cache: Optional[object] = None
 
 
 class Ticket:
@@ -317,6 +332,20 @@ class QueryService:
             if cfg.breaker is not None else None
         self._retry_budget_left = max(0, cfg.retry_budget)
         self._retry_policy = RetryPolicy()   # classification only
+        # semantic result cache: explicit ServiceConfig object wins, else
+        # the session's EngineConfig.result_cache flag arms the engine-
+        # configured tiers; attached to the session so maintenance DML
+        # publishes LF_*/DF_* deltas into it (IVM)
+        rc_cfg = cfg.result_cache
+        if rc_cfg is None and getattr(session.config, "result_cache",
+                                      False):
+            from ..engine.result_cache import ResultCacheConfig
+            rc_cfg = ResultCacheConfig.from_engine(session.config)
+        self.result_cache = None
+        if rc_cfg is not None:
+            from ..engine.result_cache import ResultCache
+            self.result_cache = ResultCache(session, rc_cfg)
+            session.attach_result_cache(self.result_cache)
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "QueryService":
@@ -437,10 +466,20 @@ class QueryService:
             ticket._queue_span = TRACER.span(
                 "service/queue", cat="service", parent=ticket.trace_id,
                 label=ticket.label).begin()
-            self._intake.append(ticket)
-            self._cv.notify_all()
+            # exact tier at ADMISSION: a text seen before never reaches a
+            # planner thread or the device lane — decided before the
+            # ticket enters the intake queue so no worker can race the
+            # completion (admission accounting + trace context stay
+            # uniform; _finish_cached releases both)
+            cached = None if self.result_cache is None else \
+                self.result_cache.lookup_text(query)
+            if cached is None:
+                self._intake.append(ticket)
+                self._cv.notify_all()
         FLIGHT.record("admit", label=ticket.label, tenant=tenant,
                       depth=depth, trace_id=ticket.trace_id or None)
+        if cached is not None:
+            self._finish_cached(ticket, cached)
         return ticket
 
     def sql(self, query: str, label: Optional[str] = None,
@@ -456,6 +495,17 @@ class QueryService:
     def _auto_label(query: str) -> str:
         import hashlib
         return "q" + hashlib.sha1(query.encode()).hexdigest()[:8]
+
+    def _finish_cached(self, ticket: Ticket, hit) -> None:
+        """Complete a ticket from the result cache: the result Table is
+        shared read-only across every hit (the same contract batched
+        parameter-identical tickets already live under)."""
+        wait = ticket.mark_started()
+        _metrics.SERVICE_QUEUE_WAIT_MS.inc(wait)
+        stats = ExecStats(
+            mode="cached" if hit.kind == "exact" else "cached_subsumed",
+            queue_wait_ms=wait, trace_id=ticket.trace_id or None)
+        self._finish_ticket(ticket, result=hit.table, stats=stats)
 
     # -- planner stage -------------------------------------------------------
     def _plan_worker(self) -> None:
@@ -486,6 +536,18 @@ class QueryService:
             FLIGHT.record("plan", label=ticket.label, tenant=ticket.tenant,
                           template=ticket.template,
                           ms=round(plan_ms, 3), batchable=bool(ticket.fp))
+            if self.result_cache is not None:
+                # plan-level tiers: a first-sighting TEXT of an already-
+                # cached template (exact by fingerprint + parameters), or
+                # a provably-narrower filter answered by re-filtering the
+                # cached coarser aggregate — either way the device lane
+                # never sees the ticket
+                hit = self.result_cache.lookup_plan(
+                    ticket.query, ticket.plan, ticket.fp, ticket.pvalues,
+                    use_jax=ticket.use_jax)
+                if hit is not None:
+                    self._finish_cached(ticket, hit)
+                    continue
             ticket.begin_wait()
             with self._cv:
                 self._ready.append(ticket)
@@ -630,6 +692,9 @@ class QueryService:
                               batched_with=len(members) - 1,
                               batch_rows=len(rows), dedup=dedup).begin()
                   for t in members]
+        cache = self.result_cache
+        cache_gens = cache.snapshot_gens(members[0].plan) \
+            if cache is not None and members[0].plan is not None else None
         t0 = time.perf_counter()
         with session._sql_lock:
             jexec = session._jax_executor()
@@ -683,20 +748,28 @@ class QueryService:
                       ms=round(exec_ms, 3))
         cells: dict[int, tuple] = {}
 
-        def shared_cell(ri):
+        def shared_cell(ri, rep):
             # parameter-identical tickets share ONE materialized Table:
             # the row was computed once, so it converts once too (first
             # result() call wins, the rest reuse) — and conversion happens
-            # on client threads, not behind the device lane
+            # on client threads, not behind the device lane. The result
+            # cache rides the same deferred conversion: the first
+            # materialization also stores the entry (with the lane-time
+            # generation snapshot, so a racing registration invalidates)
             if ri not in cells:
                 cell = {"dt": outs[ri], "table": None,
                         "lock": threading.Lock()}
 
-                def mat(_cell=cell):
+                def mat(_cell=cell, _rep=rep):
                     with _cell["lock"]:
                         if _cell["table"] is None:
                             _cell["table"] = to_host(_cell["dt"])
                             _cell["dt"] = None
+                            if cache is not None and _rep.plan is not None:
+                                cache.store(_rep.query, _rep.plan,
+                                            _rep.fp, _rep.pvalues,
+                                            _cell["table"], use_jax=True,
+                                            gens=cache_gens)
                     return _cell["table"]
                 cells[ri] = (cell, mat)
             return cells[ri]
@@ -707,7 +780,7 @@ class QueryService:
                               queue_wait_ms=wait,
                               batched_with=len(members) - 1,
                               trace_id=t.trace_id or None)
-            cell, mat = shared_cell(ri)
+            cell, mat = shared_cell(ri, t)
             self._finish_ticket(t, result=cell, stats=stats,
                                 materialize=lambda _c, _m=mat: _m(_c))
         with session._sql_lock:
@@ -731,6 +804,11 @@ class QueryService:
         ticket.attempts += 1
         wait = ticket.mark_started()
         _metrics.SERVICE_QUEUE_WAIT_MS.inc(wait)
+        # generation snapshot BEFORE dispatch: a registration racing the
+        # execution then stamps the stored entry stale instead of current
+        gens = None
+        if self.result_cache is not None and ticket.plan is not None:
+            gens = self.result_cache.snapshot_gens(ticket.plan)
         t0 = time.perf_counter()
         try:
             # hop 2, serial lane: the session's own "query" span tree
@@ -761,6 +839,10 @@ class QueryService:
             stats = ExecStats(mode="host")
         stats.queue_wait_ms = wait
         stats.trace_id = ticket.trace_id or None
+        if self.result_cache is not None and ticket.plan is not None:
+            self.result_cache.store(ticket.query, ticket.plan, ticket.fp,
+                                    ticket.pvalues, table,
+                                    use_jax=ticket.use_jax, gens=gens)
         self._finish_ticket(ticket, result=table, stats=stats)
 
     def _dispatch_serial(self, ticket: Ticket):

@@ -482,12 +482,23 @@ class Warehouse:
             files = wt.current_files()
             if not files:
                 continue
+            # skip tables whose snapshot is UNCHANGED since this session
+            # registered them: the loaders still point at the same
+            # immutable files, so re-registering would only bump the
+            # table's generation and cold every cache keyed on it (device
+            # scan cache, stream cache, result cache). A maintenance
+            # INSERT into store_sales then re-registers ONE table, not 24.
+            dec = session._dec_as_int()
+            src_key = (tuple(files), dec,
+                       (est_rows or {}).get(name))
+            if name in session._schemas and \
+                    session._source_files.get(name) == src_key:
+                continue
             # dictionary-encoded string chunks pass through as codes +
             # dictionary (arrow_bridge.parquet_dataset_format): the staging
             # thread stops re-running dictionary_encode() per morsel
             fmt = arrow_bridge.parquet_dataset_format(files) or "parquet"
             dataset = pa_dataset.dataset(files, format=fmt)
-            dec = session._dec_as_int()
             names, dtypes = arrow_bridge.engine_schema(dataset.schema, dec)
             session._schemas[name] = (names, dtypes)
             # NDS dimension surrogate keys are unique by spec: declare them
@@ -511,5 +522,6 @@ class Warehouse:
                 wt.column_stats(files, dec)
             session._enc_stats_sources[name] = session._manifest_enc_source(
                 wt, tuple(files), dataset, dec)
+            session._source_files[name] = src_key
             session._drop_cached(name)
-            session._generation += 1
+            session._bump_generation(name)

@@ -54,6 +54,10 @@ STRICT_ZERO = (
     # or quarantine a program — movement here means the self-healing
     # machinery fired on healthy traffic
     "circuit_trips", "quarantined_programs",
+    # semantic result cache: the gate workload runs with the cache OFF,
+    # so a hit here means some layer armed it (or served a cached
+    # result) without being asked — a behavior regression, never noise
+    "result_cache_hits",
 )
 
 #: report-only name suffixes: wall-clock and byte-volume metrics flake

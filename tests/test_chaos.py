@@ -538,3 +538,38 @@ def test_campaign_small_all_points(tmp_path):
     # not a 1-core CI assertion: completion is the functional bar)
     assert rec["phases"]["recovery"]["completed"] == \
         rec["phases"]["recovery"]["queries"]
+
+
+def test_campaign_with_result_cache_armed(tmp_path):
+    """The semantic result cache under fire: a campaign with the cache
+    armed must stay clean — fault firings never serve a stale or torn
+    cached result (every completed response, cached tier included, is
+    hash-identical to the fault-free baseline in ALL THREE phases), and
+    the cache actually served traffic (hits > 0, so the invariant was
+    exercised, not vacuous)."""
+    from nds_tpu.engine.result_cache import ResultCacheConfig
+
+    spec = CampaignSpec(seed=0xCAC4E, clients=6, queries_per_client=4,
+                        times_per_point=1, dump_dir=None,
+                        retry_budget=32)
+    session = build_demo_session(str(tmp_path))
+    cfg = ServiceConfig(
+        max_pending=max(256, 4 * spec.clients),
+        breaker=CircuitBreakerConfig(open_s=spec.breaker_open_s,
+                                     min_failures=spec.breaker_min_failures),
+        retry_budget=spec.retry_budget,
+        ticket_attempts=spec.ticket_attempts,
+        result_cache=ResultCacheConfig(subsumption=True))
+    before = METRICS.snapshot()
+    rec = ChaosCampaign(spec, demo_pool()).run(session,
+                                               service_config=cfg)
+    delta = METRICS.delta(before)
+    inv = rec["invariants"]
+    assert inv["all_failures_typed"], rec["phases"]["armed"]
+    assert inv["completed_hash_identical"], rec["phases"]["armed"]
+    assert delta.get("result_cache_hits", 0) > 0, delta
+    # a cached response can never be torn by a fault mid-serve: entries
+    # are stored only from COMPLETED executions, so with zero untyped
+    # escapes the armed phase's completions all hashed clean above
+    assert rec["phases"]["recovery"]["completed"] == \
+        rec["phases"]["recovery"]["queries"]

@@ -1,0 +1,503 @@
+"""Semantic result cache (nds_tpu/engine/result_cache.py): exact-tier
+hit/miss/invalidation semantics, the subsumption proof battery (accepts
+AND adversarial rejects), incremental view maintenance from LF_*/DF_*
+deltas, and the query-service wiring.
+
+The contract under test is the cache's acceptance bar: every answer a
+tier serves must be BIT-IDENTICAL to recomputing the same SQL on the
+current data — through exact hits, re-filtered coarser aggregates, and
+partials updated in place across maintenance rounds. Counters (not wall
+times — this host's timing flakes) pin that repeat loads do zero planner
+and device work.
+"""
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from nds_tpu.config import EngineConfig
+from nds_tpu.engine import ResultCache, ResultCacheConfig, Session
+from nds_tpu.obs.metrics import METRICS
+
+N_FACT = 20_000
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(11)
+    fact = pa.table({
+        "g": pa.array(rng.integers(0, 40, N_FACT), type=pa.int64()),
+        "v": pa.array(rng.integers(1, 100, N_FACT), type=pa.int64()),
+        "f": pa.array(np.round(rng.uniform(0, 10, N_FACT), 3)),
+    })
+    other = pa.table({"x": pa.array(np.arange(10), type=pa.int64())})
+    return {"fact": fact, "other": other}
+
+
+def make_session(data, **cfg_kw):
+    s = Session(EngineConfig(**cfg_kw))
+    s.register_arrow("fact", data["fact"])
+    s.register_arrow("other", data["other"])
+    return s
+
+
+def cache_for(session, **kw) -> ResultCache:
+    cache = ResultCache(session, ResultCacheConfig(**kw))
+    session.attach_result_cache(cache)
+    return cache
+
+
+Q = ("SELECT g, COUNT(*) AS n, SUM(v) AS tv FROM fact "
+     "WHERE g BETWEEN {a} AND {b} GROUP BY g ORDER BY g")
+
+
+# -- exact tier ---------------------------------------------------------------
+
+def test_exact_hit_is_bit_identical_and_counted(data):
+    s = make_session(data)
+    cache = cache_for(s)
+    sql = Q.format(a=3, b=35)
+    before = METRICS.snapshot()
+    r1 = cache.run(sql)
+    r2 = cache.run(sql)
+    d = METRICS.delta(before)
+    assert r2 is r1                       # one shared read-only Table
+    assert d.get("result_cache_misses") == 1
+    assert d.get("result_cache_hits") == 1
+    assert r1.to_pylist() == make_session(data).sql(sql).to_pylist()
+
+
+def test_ttl_expires_entries(data):
+    s = make_session(data)
+    cache = cache_for(s, ttl_s=0.2)
+    sql = Q.format(a=5, b=30)
+    cache.run(sql)
+    assert len(cache) == 1
+    time.sleep(0.5)
+    before = METRICS.snapshot()
+    cache.run(sql)
+    d = METRICS.delta(before)
+    assert d.get("result_cache_misses") == 1
+    assert d.get("result_cache_invalidations") == 1
+
+
+def test_generation_invalidation_on_reregister(data):
+    s = make_session(data)
+    cache = cache_for(s)                  # no IVM: stale entries drop
+    sql = Q.format(a=5, b=30)
+    cache.run(sql)
+    s.register_arrow("fact", data["fact"])    # same data, new generation
+    before = METRICS.snapshot()
+    r = cache.run(sql)
+    d = METRICS.delta(before)
+    assert d.get("result_cache_misses") == 1
+    assert d.get("result_cache_invalidations") == 1
+    assert r.to_pylist() == make_session(data).sql(sql).to_pylist()
+
+
+def test_per_table_generation_scopes_invalidation(data):
+    """Satellite pin: re-registering an UNRELATED table must not evict a
+    cached result over fact (the old single global counter did)."""
+    s = make_session(data)
+    cache = cache_for(s)
+    sql = Q.format(a=5, b=30)
+    cache.run(sql)
+    gen_before = s.table_generation("fact")
+    s.register_arrow("other", data["other"])
+    assert s.table_generation("fact") == gen_before
+    assert s.table_generation("other") == gen_before + 1
+    before = METRICS.snapshot()
+    cache.run(sql)
+    d = METRICS.delta(before)
+    assert d.get("result_cache_hits") == 1
+    assert not d.get("result_cache_invalidations")
+
+
+def test_capacity_lru_eviction(data):
+    s = make_session(data)
+    cache = cache_for(s, entries=2)
+    texts = [Q.format(a=1 + i, b=38) for i in range(3)]
+    for t in texts:
+        cache.run(t)
+    assert len(cache) == 2
+    before = METRICS.snapshot()
+    cache.run(texts[0])                   # oldest: evicted, re-executes
+    cache.run(texts[2])                   # newest: still cached
+    d = METRICS.delta(before)
+    assert d.get("result_cache_misses") == 1
+    assert d.get("result_cache_hits") == 1
+
+
+def test_backend_keying_separates_jax_and_numpy(data):
+    """A numpy-oracle result must never serve a jax query (hashes may
+    differ across backends): entries key on the backend tag."""
+    s = make_session(data)
+    cache = cache_for(s)
+    sql = Q.format(a=4, b=33)
+    r_np = cache.run(sql, backend="numpy")
+    before = METRICS.snapshot()
+    r_jax = cache.run(sql, backend="jax")
+    d = METRICS.delta(before)
+    assert d.get("result_cache_misses") == 1      # no cross-backend hit
+    assert len(cache) == 2
+    assert r_np.to_pylist() == r_jax.to_pylist()  # same logical answer
+
+
+# -- subsumption tier ---------------------------------------------------------
+
+def subs_cache(data):
+    s = make_session(data)
+    return s, cache_for(s, subsumption=True)
+
+
+def test_subsume_narrower_between_window(data):
+    s, cache = subs_cache(data)
+    cache.run(Q.format(a=2, b=38))            # the coarse entry
+    narrow = Q.format(a=10, b=25)
+    before = METRICS.snapshot()
+    r = cache.run(narrow)
+    d = METRICS.delta(before)
+    assert d.get("result_cache_subsumption_hits") == 1
+    assert not d.get("queries_run")           # no execution at all
+    assert r.to_pylist() == make_session(data).sql(narrow).to_pylist()
+    # the narrowed answer became its own exact entry
+    before = METRICS.snapshot()
+    cache.run(narrow)
+    assert METRICS.delta(before).get("result_cache_hits") == 1
+
+
+def test_subsume_inlist_subset(data):
+    s, cache = subs_cache(data)
+    tpl = ("SELECT g, COUNT(*) AS n, SUM(v) AS tv FROM fact "
+           "WHERE g IN ({vals}) GROUP BY g ORDER BY g")
+    cache.run(tpl.format(vals="3, 7, 11, 19, 23"))
+    narrow = tpl.format(vals="7, 19")
+    before = METRICS.snapshot()
+    r = cache.run(narrow)
+    assert METRICS.delta(before).get("result_cache_subsumption_hits") == 1
+    assert r.to_pylist() == make_session(data).sql(narrow).to_pylist()
+
+
+def _assert_no_subsume(data, cache, wide_sql, narrow_sql):
+    cache.run(wide_sql)
+    before = METRICS.snapshot()
+    r = cache.run(narrow_sql)
+    d = METRICS.delta(before)
+    assert not d.get("result_cache_subsumption_hits"), (wide_sql,
+                                                        narrow_sql)
+    assert d.get("result_cache_misses") == 1
+    assert r.to_pylist() == make_session(data).sql(narrow_sql).to_pylist()
+
+
+def test_reject_filter_not_over_group_key(data):
+    """WHERE over a non-group column: per-group inputs differ, so the
+    cached aggregate rows cannot be re-filtered into the answer."""
+    s, cache = subs_cache(data)
+    tpl = ("SELECT g, COUNT(*) AS n, SUM(v) AS tv FROM fact "
+           "WHERE v BETWEEN {a} AND {b} GROUP BY g ORDER BY g")
+    _assert_no_subsume(data, cache, tpl.format(a=1, b=90),
+                       tpl.format(a=10, b=50))
+
+
+def test_reject_non_mergeable_aggregate(data):
+    s, cache = subs_cache(data)
+    tpl = ("SELECT g, STDDEV_SAMP(f) AS sd FROM fact "
+           "WHERE g BETWEEN {a} AND {b} GROUP BY g ORDER BY g")
+    _assert_no_subsume(data, cache, tpl.format(a=2, b=38),
+                       tpl.format(a=10, b=25))
+
+
+def test_reject_or_widened_predicate(data):
+    """A parameter under OR is opaque: the conjunct decomposition only
+    splits AND, so the slot never gets a containment direction."""
+    s, cache = subs_cache(data)
+    tpl = ("SELECT g, COUNT(*) AS n FROM fact "
+           "WHERE g <= {b} OR v > 95 GROUP BY g ORDER BY g")
+    _assert_no_subsume(data, cache, tpl.format(b=38), tpl.format(b=20))
+
+
+def test_reject_widened_window(data):
+    s, cache = subs_cache(data)
+    _assert_no_subsume(data, cache, Q.format(a=10, b=25),
+                       Q.format(a=2, b=38))
+
+
+def test_reject_limit_above_aggregate(data):
+    """LIMIT truncated the cached groups; the narrower query may need a
+    group the cached result dropped."""
+    s, cache = subs_cache(data)
+    tpl = ("SELECT g, COUNT(*) AS n FROM fact WHERE g >= {a} "
+           "GROUP BY g ORDER BY g LIMIT 5")
+    _assert_no_subsume(data, cache, tpl.format(a=2), tpl.format(a=10))
+
+
+def test_reject_moved_point_equality(data):
+    s, cache = subs_cache(data)
+    tpl = ("SELECT g, COUNT(*) AS n FROM fact WHERE g = {a} "
+           "GROUP BY g ORDER BY g")
+    _assert_no_subsume(data, cache, tpl.format(a=5), tpl.format(a=6))
+
+
+# -- incremental view maintenance (synthetic) ---------------------------------
+
+def _warehouse_session(tmp_path, data, **cache_kw):
+    from nds_tpu.warehouse import Warehouse
+
+    wh = Warehouse(str(tmp_path / "wh"))
+    wh.table("fact").create(data["fact"], partition=False)
+    s = Session(EngineConfig())
+    s.attach_warehouse(wh)
+    s.register_arrow("stage", pa.table({
+        "sg": pa.array(np.arange(30, dtype=np.int64) % 40),
+        "sv": pa.array((np.arange(30, dtype=np.int64) * 7) % 90 + 1),
+        "sf": pa.array(np.round(np.linspace(0, 5, 30), 3)),
+    }))
+    return s, cache_for(s, **cache_kw), wh
+
+
+AGG = ("SELECT g, COUNT(*) AS n, SUM(v) AS tv, MIN(v) AS mv FROM fact "
+       "GROUP BY g ORDER BY g")
+
+
+def _cold(wh):
+    s = Session(EngineConfig())
+    s.attach_warehouse(wh)
+    return s
+
+
+def test_ivm_insert_merges_partials(tmp_path, data):
+    s, cache, wh = _warehouse_session(tmp_path, data, ivm=True)
+    cache.run(AGG)
+    before = METRICS.snapshot()
+    s.execute("INSERT INTO fact SELECT sg, sv, sf FROM stage")
+    d = METRICS.delta(before)
+    assert d.get("result_cache_ivm_updates") == 1
+    assert not d.get("result_cache_invalidations")
+    before = METRICS.snapshot()
+    served = cache.run(AGG)
+    assert METRICS.delta(before).get("result_cache_hits") == 1
+    assert served.to_pylist() == _cold(wh).sql(AGG).to_pylist()
+
+
+def test_ivm_delete_recomputes_touched_groups(tmp_path, data):
+    s, cache, wh = _warehouse_session(tmp_path, data, ivm=True)
+    cache.run(AGG)
+    before = METRICS.snapshot()
+    s.execute("DELETE FROM fact WHERE v < 40 AND g IN (3, 9, 17)")
+    d = METRICS.delta(before)
+    assert d.get("result_cache_ivm_updates") == 1
+    served = cache.run(AGG)
+    assert served.to_pylist() == _cold(wh).sql(AGG).to_pylist()
+
+
+def test_float_sum_entry_invalidates_instead_of_merging(tmp_path, data):
+    """f64 sums do not re-associate bit-stably, so a float-sum aggregate
+    is IVM-ineligible: the delta invalidates it and the next load
+    recomputes (still correct, just cold)."""
+    s, cache, wh = _warehouse_session(tmp_path, data, ivm=True)
+    sql = ("SELECT g, SUM(f) AS tf FROM fact GROUP BY g ORDER BY g")
+    cache.run(sql)
+    before = METRICS.snapshot()
+    s.execute("INSERT INTO fact SELECT sg, sv, sf FROM stage")
+    d = METRICS.delta(before)
+    assert not d.get("result_cache_ivm_updates")
+    assert d.get("result_cache_invalidations") == 1
+    before = METRICS.snapshot()
+    served = cache.run(sql)
+    assert METRICS.delta(before).get("result_cache_misses") == 1
+    assert served.to_pylist() == _cold(wh).sql(sql).to_pylist()
+
+
+# -- query-service wiring -----------------------------------------------------
+
+def test_service_admission_hit_does_zero_planner_device_work(data):
+    from nds_tpu.service import QueryService, ServiceConfig
+
+    s = make_session(data)
+    sql = Q.format(a=5, b=30)
+    want = make_session(data).sql(sql).to_pylist()
+    cfg = ServiceConfig(result_cache=ResultCacheConfig())
+    with QueryService(s, cfg) as svc:
+        t1 = svc.submit(sql, label="cold")
+        assert t1.result(60).to_pylist() == want
+        before = METRICS.snapshot()
+        h_before = METRICS.histograms()
+        t2 = svc.submit(sql, label="warm")
+        r2 = t2.result(60)
+        d = METRICS.delta(before)
+        h_after = METRICS.histograms()
+    assert t2.stats.mode == "cached"
+    assert r2.to_pylist() == want
+    assert d.get("result_cache_hits") == 1
+    # ZERO planner/device work, pinned by counters (not wall time):
+    # no session execution, no compile, no batch, no plan-stage sample
+    assert not d.get("queries_run")
+    assert not d.get("compiles")
+    assert not d.get("service_batches")
+    plan_n = h_after.get("service_plan_ms", {}).get("count", 0) - \
+        h_before.get("service_plan_ms", {}).get("count", 0)
+    assert plan_n == 0
+
+
+def test_service_subsumption_and_engine_flag_wiring(data):
+    """EngineConfig.result_cache arms the service cache when the
+    ServiceConfig leaves it unset; narrower windows serve subsumed."""
+    from nds_tpu.service import QueryService, ServiceConfig
+
+    s = make_session(data, result_cache=True,
+                     result_cache_subsumption=True)
+    narrow = Q.format(a=12, b=22)
+    want = make_session(data).sql(narrow).to_pylist()
+    with QueryService(s, ServiceConfig()) as svc:
+        assert svc.result_cache is not None
+        svc.sql(Q.format(a=2, b=38), label="coarse")
+        before = METRICS.snapshot()
+        t = svc.submit(narrow, label="narrow")
+        r = t.result(60)
+        d = METRICS.delta(before)
+    assert t.stats.mode == "cached_subsumed"
+    assert d.get("result_cache_subsumption_hits") == 1
+    assert not d.get("queries_run")
+    assert r.to_pylist() == want
+
+
+def test_service_batched_members_store_and_rehit(data):
+    from nds_tpu.service import QueryService, ServiceConfig
+
+    s = make_session(data)
+    cfg = ServiceConfig(result_cache=ResultCacheConfig(), max_batch=8)
+    texts = [Q.format(a=6 + i, b=31 + i) for i in range(3)]
+    with QueryService(s, cfg) as svc:
+        svc.sql(texts[0], label="w")      # record + publish the program
+        svc.sql(texts[0], label="w2")     # (second run compiles)
+        with svc.hold_dispatch():
+            tickets = [svc.submit(t, label=f"b{i}")
+                       for i, t in enumerate(texts[1:])]
+            t0 = time.time()
+            while time.time() - t0 < 30:
+                with svc._cv:
+                    if len(svc._ready) >= len(tickets):
+                        break
+                time.sleep(0.01)
+        for t in tickets:
+            t.result(60)
+        # repeats of the batched members hit at admission
+        before = METRICS.snapshot()
+        for i, text in enumerate(texts[1:]):
+            t = svc.submit(text, label=f"r{i}")
+            assert t.result(60) is not None
+            assert t.stats.mode == "cached"
+        d = METRICS.delta(before)
+    assert d.get("result_cache_hits") == len(texts) - 1
+    assert not d.get("queries_run")
+
+
+# -- LF_*/DF_* differential suite (SF0.001 warehouse) -------------------------
+
+#: int-only aggregate probes (order-safe partials: IVM-eligible even on
+#: a float-decimal warehouse) — one per maintenance-touched fact table
+PROBES = {
+    "store_sales": ("SELECT ss_store_sk, COUNT(*) AS n, "
+                    "SUM(ss_quantity) AS q FROM store_sales "
+                    "GROUP BY ss_store_sk ORDER BY ss_store_sk"),
+    "store_returns": ("SELECT sr_store_sk, COUNT(*) AS n, "
+                      "SUM(sr_return_quantity) AS q FROM store_returns "
+                      "GROUP BY sr_store_sk ORDER BY sr_store_sk"),
+    "catalog_sales": ("SELECT cs_call_center_sk, COUNT(*) AS n, "
+                      "SUM(cs_quantity) AS q FROM catalog_sales "
+                      "GROUP BY cs_call_center_sk "
+                      "ORDER BY cs_call_center_sk"),
+    "catalog_returns": ("SELECT cr_call_center_sk, COUNT(*) AS n, "
+                        "SUM(cr_return_quantity) AS q "
+                        "FROM catalog_returns GROUP BY cr_call_center_sk "
+                        "ORDER BY cr_call_center_sk"),
+    "web_sales": ("SELECT ws_web_site_sk, COUNT(*) AS n, "
+                  "SUM(ws_quantity) AS q FROM web_sales "
+                  "GROUP BY ws_web_site_sk ORDER BY ws_web_site_sk"),
+    "web_returns": ("SELECT wr_web_page_sk, COUNT(*) AS n, "
+                    "SUM(wr_return_quantity) AS q FROM web_returns "
+                    "GROUP BY wr_web_page_sk ORDER BY wr_web_page_sk"),
+    "inventory": ("SELECT inv_warehouse_sk, COUNT(*) AS n, "
+                  "SUM(inv_quantity_on_hand) AS q FROM inventory "
+                  "GROUP BY inv_warehouse_sk ORDER BY inv_warehouse_sk"),
+}
+
+
+@pytest.fixture(scope="module")
+def maint_env(tmp_path_factory):
+    """SF0.001 base data + the smallest update set that carries staging
+    rows (SF0.01), transcoded once into a pristine warehouse template —
+    each test copies it so maintenance rounds stay isolated."""
+    from nds_tpu.transcode import transcode
+
+    root = tmp_path_factory.mktemp("rcache_maint")
+    base = str(root / "base")
+    upd = str(root / "upd")
+    subprocess.run([sys.executable, "-m", "nds_tpu.datagen", "local", base,
+                    "--scale", "0.001", "--parallel", "1"], check=True,
+                   timeout=600)
+    subprocess.run([sys.executable, "-m", "nds_tpu.datagen", "local", upd,
+                    "--scale", "0.01", "--parallel", "1", "--update", "1"],
+                   check=True, timeout=600)
+    pristine = str(root / "wh_pristine")
+    transcode(base, pristine)
+    return {"upd": upd, "pristine": pristine}
+
+
+def _run_ivm_differential(maint_env, tmp_path, funcs, probe_tables):
+    """Prime cached probe entries, run the maintenance functions through
+    the SAME session (deltas publish into the cache), then assert every
+    probe serves from cache AND hashes identical to a cold session over
+    the post-maintenance warehouse."""
+    from nds_tpu.maintenance import run_maintenance
+    from nds_tpu.warehouse import Warehouse
+
+    wh_dir = str(tmp_path / "wh")
+    shutil.copytree(maint_env["pristine"], wh_dir)
+    s = Session(EngineConfig())
+    s.attach_warehouse(Warehouse(wh_dir))
+    cache = cache_for(s, ivm=True)
+    for t in probe_tables:
+        cache.run(PROBES[t])
+    before = METRICS.snapshot()
+    run_maintenance(wh_dir, maint_env["upd"], str(tmp_path / "maint.csv"),
+                    maintenance_queries=list(funcs), session=s)
+    delta = METRICS.delta(before)
+    assert delta.get("result_cache_ivm_updates", 0) > 0, delta
+    for t in probe_tables:
+        before = METRICS.snapshot()
+        served = cache.run(PROBES[t])
+        d = METRICS.delta(before)
+        assert d.get("result_cache_hits") == 1, (t, d)
+        cold = Session(EngineConfig())
+        cold.attach_warehouse(Warehouse(wh_dir))
+        want = cold.sql(PROBES[t]).to_pylist()
+        assert served.to_pylist() == want, \
+            f"{t}: cached-updated != cold recompute after {funcs}"
+    return delta
+
+
+def test_ivm_differential_fast_slice(maint_env, tmp_path):
+    """Tier-1 slice: one fact insert (LF_SS), one paired delete (DF_SS),
+    one inventory insert (LF_I). catalog_sales rides along UNTOUCHED to
+    pin per-table generation scope at warehouse grain: three maintenance
+    functions over other tables must leave its entry hot."""
+    delta = _run_ivm_differential(
+        maint_env, tmp_path, ["LF_SS", "DF_SS", "LF_I"],
+        ["store_sales", "store_returns", "inventory", "catalog_sales"])
+    # LF_SS:1 + DF_SS: 3 date tuples x (returns, sales) + LF_I:1
+    assert delta.get("result_cache_ivm_updates", 0) >= 3
+    assert not delta.get("result_cache_invalidations")
+
+
+@pytest.mark.slow
+def test_ivm_differential_full_sweep(maint_env, tmp_path):
+    from nds_tpu.maintenance import MAINTENANCE_FUNCS
+
+    _run_ivm_differential(maint_env, tmp_path, MAINTENANCE_FUNCS,
+                          list(PROBES))

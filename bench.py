@@ -388,6 +388,11 @@ def main(argv=None) -> None:
         # histogram snapshots (count/sum/min/max + sparse log buckets):
         # scripts/obs_report.py renders quantile tables from this block
         "histograms": METRICS.histograms(),
+        # device-memory watermarks (obs/profile.DEVICE_MEM): tracked
+        # upload/codebook live set, its process peak, and the headroom to
+        # the HBM scan budget — the "how close did this run get to the
+        # ceiling" answer per bench round
+        "memory": _memory_block(config),
     }
     if mesh_scaling is not None:
         # per-shard-count scaling of the same slice (sharded morsel
@@ -408,6 +413,14 @@ def main(argv=None) -> None:
         log.info("top programs by device time:\n%s",
                  format_table(device_time_programs))
     print(json.dumps(out))
+
+
+def _memory_block(config) -> dict:
+    """The bench JSON ``memory`` block (obs/profile.memory_block against
+    this run's configured HBM scan budget)."""
+    from nds_tpu.obs.profile import memory_block
+    return memory_block(int(config.scan_budget_gb * (1 << 30))
+                        if config.scan_budget_gb > 0 else None)
 
 
 def _run_mesh_scaling(counts, wh_dir, query_dict, units, decimal,

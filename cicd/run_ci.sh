@@ -19,7 +19,10 @@
 #                their SQLite-oracle exactness and bit-identity gates run
 #                early and cheaply; the obs suite gates here because the
 #                tracer/metrics hooks thread through the same session/
-#                streaming paths
+#                streaming paths, and the EXPLAIN ANALYZE suite
+#                (tests/test_profile.py: profiled-vs-normal bit-identity,
+#                exact per-node rows, cardinality audit, device-memory
+#                watermarks) for the same reason
 #   encoded    - encoded execution tier-1 (fast differentials): the
 #                dictionary/RLE pack/unpack property round trip, streamed
 #                on/off bit-identity + numpy-oracle differentials,
@@ -126,10 +129,15 @@ stage_static() {
 }
 
 stage_planner() {
+    # test_profile.py gates here too: EXPLAIN ANALYZE profiled-vs-normal
+    # bit-identity (in-core/streamed/encoded/sharded), per-node row
+    # exactness, the cardinality audit, device-memory watermarks, and the
+    # metrics-glossary completeness check — the profiling hooks thread
+    # through the same planner/session/streaming paths this stage owns
     (cd "$REPO" && python -m pytest tests/test_late_materialization.py \
         tests/test_capacity_ladder.py tests/test_shared_scan.py \
         tests/test_streaming.py tests/test_narrow_lanes.py \
-        tests/test_obs.py -q)
+        tests/test_obs.py tests/test_profile.py -q)
 }
 
 stage_encoded() {

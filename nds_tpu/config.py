@@ -127,6 +127,23 @@ class EngineConfig:
     # pallas_fallback_reason in last_exec_stats. Property:
     # nds.tpu.pallas_ops=sort,groupby,gather; power --pallas_ops.
     pallas_ops: tuple[str, ...] = ()
+    # EXPLAIN ANALYZE: profiled execution mode (obs/profile.py). When on,
+    # every sql() statement executes node-by-node EAGERLY through the
+    # existing executor (children memoized, so each node's wall is its
+    # own work) with exact per-node row counts, output bytes, a static-
+    # estimate-vs-actual cardinality audit, and device-memory watermarks
+    # — results BIT-IDENTICAL to normal execution (streamed queries run
+    # their unchanged morsel path and only read counters). The profile
+    # lands on Session.last_profile / ExecStats.node_stats; render via
+    # PlanProfile.render() / scripts/explain_report.py. OFF by default:
+    # the disabled path adds zero counters and zero per-node work.
+    # Property: nds.tpu.profile_plans; power exposes --explain;
+    # Session.explain_analyze() profiles one statement without the flag.
+    profile_plans: bool = False
+    # cardinality-audit threshold: a node whose actual row count diverges
+    # from the planner's static estimate by at least this ratio (either
+    # direction) is flagged as a misestimate finding
+    profile_misestimate_ratio: float = 8.0
     # static plan-IR verification between planner rewrite passes
     # (engine/verify.py via planner.PassPipeline):
     #   "off"      — zero verification cost (bench/production default)

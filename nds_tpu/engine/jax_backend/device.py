@@ -181,8 +181,19 @@ jax.tree_util.register_pytree_node(DTable, _dtable_flatten, _dtable_unflatten)
 
 # -- host <-> device bridging ------------------------------------------------
 
+def _mem_leaves(dt) -> list:
+    """[(id, nbytes)] of a pytree's device-array leaves — the unit the
+    device-memory watermark accountant (obs/profile.DEVICE_MEM) tracks.
+    Identity-keyed so add/free stay balanced even when the same buffer
+    flows through several caches."""
+    return [(id(leaf), int(leaf.size) * leaf.dtype.itemsize)
+            for leaf in jax.tree_util.tree_leaves(dt)
+            if hasattr(leaf, "size") and hasattr(leaf, "dtype")]
+
+
 def to_device(table: Table, capacity: Optional[int] = None,
               device=None) -> DTable:
+    from ...obs.profile import DEVICE_MEM
     from ...obs.trace import TRACER
     from ...resilience import FAULTS
     FAULTS.fire("device.put")
@@ -190,7 +201,9 @@ def to_device(table: Table, capacity: Optional[int] = None,
     cap = capacity if capacity is not None else bucket(n)
     with TRACER.span("upload", cat="upload", rows=n,
                      cols=len(table.columns), capacity=cap):
-        return _to_device(table, n, cap, device)
+        out = _to_device(table, n, cap, device)
+    DEVICE_MEM.add(_mem_leaves(out))
+    return out
 
 
 def _to_device(table: Table, n: int, cap: int, device) -> DTable:
@@ -492,18 +505,22 @@ def decode_stats() -> dict:
 
 
 def _codebook_device(book: np.ndarray) -> jax.Array:
+    from ...obs.profile import DEVICE_MEM
     ent = _BOOK_CACHE.get(id(book))
     if ent is not None and ent[0] is book:
         from ...obs import metrics as _metrics
         _metrics.DICT_UPLOADS_SAVED.inc()
         return ent[1]
     if len(_BOOK_CACHE) >= _BOOK_CACHE_MAX:
+        DEVICE_MEM.free([pair for e in _BOOK_CACHE.values()
+                         for pair in _mem_leaves(e[1])])
         _BOOK_CACHE.clear()
     # the upload must happen OUTSIDE any live trace: a traced constant
     # would be a tracer, and caching a tracer across programs leaks it
     with jax.ensure_compile_time_eval():
         dev = jnp.asarray(book)
     _BOOK_CACHE[id(book)] = (book, dev)
+    DEVICE_MEM.add(_mem_leaves(dev))
     return dev
 
 
@@ -621,7 +638,10 @@ def pack_table(table: Table, capacity: Optional[int] = None,
     FAULTS.fire("device.put")
     with TRACER.span("lane.pack", cat="upload", rows=n,
                      cols=len(table.columns), capacity=cap):
-        return _pack_table(table, lanes, n, cap, encs, codebooks)
+        out = _pack_table(table, lanes, n, cap, encs, codebooks)
+    from ...obs.profile import DEVICE_MEM
+    DEVICE_MEM.add(_mem_leaves(out))
+    return out
 
 
 def _pack_table(table: Table, lanes: tuple, n: int, cap: int,
@@ -840,6 +860,8 @@ def free_dtable(dt: "Optional[DTable | PackedTable]") -> None:
     whole scan on the host."""
     if dt is None:
         return
+    from ...obs.profile import DEVICE_MEM
+    DEVICE_MEM.free(_mem_leaves(dt))
     for leaf in jax.tree_util.tree_leaves(dt):
         if hasattr(leaf, "delete"):
             try:

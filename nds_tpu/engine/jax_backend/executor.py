@@ -81,13 +81,20 @@ _COST_ANALYSIS = os.environ.get(
 
 class _Recorder:
     """Capacity-decision schedule: recorded eagerly, consumed under trace."""
-    __slots__ = ("mode", "decisions", "idx", "checks")
+    __slots__ = ("mode", "decisions", "idx", "checks", "nodes")
 
     def __init__(self, mode: str, decisions: Optional[list] = None):
         self.mode = mode                    # "record" | "replay"
         self.decisions = decisions if decisions is not None else []
         self.idx = 0
         self.checks: list[jax.Array] = []   # traced actuals (replay only)
+        # record mode: the plan node whose execution made each decision
+        # (index-aligned with `decisions`; None = a decision with no row
+        # semantics). Replay checks are index-aligned too, so per-node
+        # ACTUAL row counts ride out of every compiled run for free
+        # (ExecStats.node_stats — the schedule already fetches the checks
+        # host-side for verification).
+        self.nodes: list = []
 
 
 # Cross-stream/-session compiled-program registry (VERDICT r4 #4): stream
@@ -175,6 +182,24 @@ def shared_fingerprint(pplan, shard_min_rows: int,
         .encode()).hexdigest()
 
 
+def _node_rows(decisions: list, node_labels: tuple, actuals: list) -> dict:
+    """{TypeName#k: actual rows} from index-aligned (decision, label,
+    actual) triples — the per-node actual row counts the schedule already
+    computes (capacity syncs at record, fetched checks at replay). Labels
+    match the plan verifier's node identities, so profiles, findings, and
+    ``ExecStats.node_stats`` all name the same node; a node with several
+    decisions keeps its largest (the output-row sync dominates probes)."""
+    rows: dict = {}
+    for (kind, _planned), lbl, actual in zip(decisions, node_labels,
+                                             actuals):
+        if lbl is None or kind not in ("cap", "exact"):
+            continue
+        a = int(actual)
+        if lbl not in rows or a > rows[lbl]:
+            rows[lbl] = a
+    return rows
+
+
 def _verify_schedule(decisions: list, checks_host: list) -> None:
     for (kind, planned), actual in zip(decisions, checks_host):
         a = int(actual)
@@ -205,10 +230,15 @@ class CompiledQuery:
     def __init__(self, plan, decisions: list, scan_keys: tuple,
                  mesh=None, param_dtypes: tuple = (),
                  shard_min_rows: int = 1 << 18, label: str = "",
-                 pallas_ops: frozenset = frozenset()):
+                 pallas_ops: frozenset = frozenset(),
+                 decision_nodes: Optional[tuple] = None):
         self.plan = plan
         self.decisions = decisions
         self.scan_keys = scan_keys
+        # per-decision TypeName#k attribution (record-time; index-aligned
+        # with decisions/checks): lets every replay report the per-node
+        # actual row counts its schedule checks already fetched
+        self.decision_nodes = decision_nodes
         self.mesh = mesh
         self.param_dtypes = param_dtypes
         self.shard_min_rows = shard_min_rows
@@ -462,6 +492,11 @@ class CompiledQuery:
                     out_host, checks_host = jax.device_get((out, checks))
             t2 = _time.perf_counter()
         _verify_schedule(self.decisions, checks_host)
+        if stats is not None and self.decision_nodes:
+            rows = _node_rows(self.decisions, self.decision_nodes,
+                              [int(c) for c in checks_host])
+            if rows:
+                stats["node_rows"] = rows
         device_ms = round((t2 - t1) * 1000, 3)
         _PROGRAMS.record_run(self.label, device_ms, first=first)
         if aot is not None:
@@ -590,6 +625,12 @@ class JaxExecutor:
                  pallas_ops=frozenset(),
                  shard_local: bool = False):
         self._load_table = load_table
+        # the plan node currently executing (execute() maintains it):
+        # capacity decisions made while it runs attribute to it, so the
+        # recorded schedule doubles as a per-node actual-row-count source
+        self._cur_node = None
+        # per-decision node list of the last record_plan/record_plans pass
+        self._last_record_nodes: Optional[list] = None
         # shard-local mode (sharded morsel execution, shard_exec): this
         # executor's trace runs INSIDE a shard_map body, one replica's rows
         # at a time. Schedule-shaping gates behave like the mesh path (no
@@ -932,7 +973,8 @@ class JaxExecutor:
                                    shard_min_rows=self._shard_min_rows,
                                    label=ent.get("label",
                                                  self._unit_label(key)),
-                                   pallas_ops=self._pallas_ops)
+                                   pallas_ops=self._pallas_ops,
+                                   decision_nodes=ent.get("decision_nodes"))
                 try:
                     out = self._run_compiled(cq, ent, keep_device)
                     ent["cq"] = cq
@@ -976,17 +1018,40 @@ class JaxExecutor:
         with TRACER.span("record", label=self._unit_label(key)):
             out, decisions, scan_keys = self.record_plan(pplan,
                                                          tuple(pvalues))
+        nodes_attr = self._decision_labels(pplan)
+        if nodes_attr:
+            # the record pass's decision VALUES are the actuals: the same
+            # per-node row counts a later replay reads from its checks
+            rows = _node_rows(decisions, nodes_attr,
+                              [v for _k, v in decisions])
+            if rows:
+                self.last_stats["node_rows"] = rows
         if key is not None and self._jit_plans:
             ent = {
                 "plan": pplan, "decisions": decisions,
                 "scan_keys": scan_keys,
                 "params": tuple(pvalues), "param_dtypes": tuple(pdtypes),
+                "decision_nodes": nodes_attr,
                 "cq": None, "nojit": len(self.fallback_nodes) > fb0,
                 "fp": fp, "label": self._unit_label(key)}
             self._publish_recorded(ent)
             self._plans[key] = ent
             self._fp_block = None
         return out
+
+    def _decision_labels(self, pplan) -> Optional[tuple]:
+        """Per-decision TypeName#k attribution of the just-recorded
+        schedule (record_plan): verify.node_labels over the parameterized
+        plan, so the labels match the session-side plan's labels exactly
+        (parameterization rewrites literals, never node structure/order).
+        None when no decision carries row semantics."""
+        nodes = self._last_record_nodes
+        if not nodes or all(n is None for n in nodes):
+            return None
+        from ..verify import node_labels
+        labs = node_labels(pplan)
+        return tuple(labs.get(id(n)) if n is not None else None
+                     for n in nodes)
 
     # -- cross-stream program sharing ----------------------------------------
     def _shared_fp(self, pplan) -> Optional[str]:
@@ -1009,6 +1074,7 @@ class JaxExecutor:
             ent = {"plan": sh["plan"], "decisions": list(sh["decisions"]),
                    "scan_keys": sh["scan_keys"], "params": pvalues,
                    "param_dtypes": pdtypes, "cq": sh.get("cq"),
+                   "decision_nodes": sh.get("decision_nodes"),
                    "nojit": False, "fp": fp}
             scan_meta = dict(sh["scan_meta"])
         for k, v in scan_meta.items():
@@ -1025,6 +1091,7 @@ class JaxExecutor:
         entry = {"plan": ent["plan"], "decisions": list(ent["decisions"]),
                  "scan_keys": ent["scan_keys"],
                  "param_dtypes": ent.get("param_dtypes", ()),
+                 "decision_nodes": ent.get("decision_nodes"),
                  "scan_meta": {k: self._scan_meta[k]
                                for k in ent["scan_keys"]
                                if k in self._scan_meta},
@@ -1181,7 +1248,8 @@ class JaxExecutor:
                                param_dtypes=ent.get("param_dtypes", ()),
                                shard_min_rows=self._shard_min_rows,
                                label=ent.get("label", self._unit_label(k)),
-                               pallas_ops=self._pallas_ops)
+                               pallas_ops=self._pallas_ops,
+                               decision_nodes=ent.get("decision_nodes"))
             todo.append((k, ent, cq, specs))
         if not todo:
             return {}
@@ -1251,6 +1319,7 @@ class JaxExecutor:
             self._rec = None
             self._params = old_params
             self._shard_local = old_shard_local
+        self._last_record_nodes = rec.nodes
         return out, rec.decisions, tuple(self._touched_scans)
 
     def record_plans(self, plans: list, params: tuple = (),
@@ -1388,6 +1457,8 @@ class JaxExecutor:
         key = id(node)
         if key in self._memo:
             return self._memo[key]
+        prev_node = self._cur_node
+        self._cur_node = node
         try:
             result = self._run(node)
         except NotImplementedError as e:
@@ -1395,6 +1466,8 @@ class JaxExecutor:
                 raise
             self.fallback_nodes.append(f"{type(node).__name__}: {e}")
             result = self._host_fallback(node)
+        finally:
+            self._cur_node = prev_node
         self._memo[key] = result
         return result
 
@@ -1410,6 +1483,7 @@ class JaxExecutor:
         if rec.mode == "record":
             v = int(scalar)
             rec.decisions.append(("cap", v))
+            rec.nodes.append(self._cur_node)
             return v
         kind, v = rec.decisions[rec.idx]
         rec.idx += 1
@@ -1426,6 +1500,7 @@ class JaxExecutor:
         if rec.mode == "record":
             v = int(scalar)
             rec.decisions.append(("exact", v))
+            rec.nodes.append(self._cur_node)
             return v
         kind, v = rec.decisions[rec.idx]
         rec.idx += 1
@@ -1447,6 +1522,7 @@ class JaxExecutor:
         if rec.mode == "record":
             v = int(fn())
             rec.decisions.append(("exact", v))
+            rec.nodes.append(None)   # eligibility probe: no row semantics
             return v
         kind, v = rec.decisions[rec.idx]
         rec.idx += 1
@@ -1471,6 +1547,7 @@ class JaxExecutor:
             return value
         if rec.mode == "record":
             rec.decisions.append(("exact", int(value)))
+            rec.nodes.append(None)   # performance branch: not a row count
             return value
         kind, v = rec.decisions[rec.idx]
         rec.idx += 1

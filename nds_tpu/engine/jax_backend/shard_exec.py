@@ -77,6 +77,8 @@ def stage_sharded(table: Table, mesh, shard_cap: int,
     x64 = jax.config.read("jax_enable_x64")
     packable = lanes is not None and (
         x64 or not any(ln in ("i64", "f64") for ln in lanes))
+    from ...obs.profile import DEVICE_MEM
+    from .device import _mem_leaves
     with TRACER.span("morsel.stage_sharded", cat="upload",
                      rows=table.num_rows, shards=n_shards,
                      capacity=shard_cap * n_shards):
@@ -90,12 +92,15 @@ def stage_sharded(table: Table, mesh, shard_cap: int,
                 payloads.append(payload)
             flat = np.concatenate(payloads)
             data = jax.device_put(flat, sharding)
-            return PackedTable(list(table.names),
-                               [c.dtype for c in table.columns],
-                               tuple(lanes), shard_cap, data, tuple(dicts),
-                               tuple(encs) if encs else (),
-                               tuple(codebooks) if codebooks else ())
-        return _sharded_dtable(table, spans, shard_cap, sharding)
+            out = PackedTable(list(table.names),
+                              [c.dtype for c in table.columns],
+                              tuple(lanes), shard_cap, data, tuple(dicts),
+                              tuple(encs) if encs else (),
+                              tuple(codebooks) if codebooks else ())
+        else:
+            out = _sharded_dtable(table, spans, shard_cap, sharding)
+    DEVICE_MEM.add(_mem_leaves(out))
+    return out
 
 
 def _sharded_dtable(table: Table, spans, shard_cap: int,

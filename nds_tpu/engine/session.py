@@ -125,6 +125,15 @@ class Session:
         # builder _finish_exec_stats (obs.stats.ExecStats)
         self.last_exec_stats: dict = {}
         self.last_exec_stats_typed: Optional[ExecStats] = None
+        # EXPLAIN ANALYZE (obs/profile.py): the PlanProfile of the last
+        # profiled execution (explain_analyze() or config.profile_plans);
+        # None until a statement runs profiled
+        self.last_profile = None
+        # raw per-run collection the streamed path always records (cheap
+        # host counters it computes anyway: per-group walls + rows, per-
+        # job partial/final rows, finalize wall) — the streamed profile
+        # and ExecStats.node_stats are built from it
+        self._last_stream_profile: Optional[dict] = None
         # label of the in-flight sql() call (runners pass the query name);
         # compiled programs inherit it for device-time attribution
         self._active_label: str = ""
@@ -622,6 +631,27 @@ class Session:
             table = self._sql_locked(query, backend, label, plan=plan)
             return table, self.last_exec_stats_typed
 
+    def explain_analyze(self, query: str, backend: Optional[str] = None,
+                        label: Optional[str] = None):
+        """EXPLAIN ANALYZE: execute ``query`` in profiled mode and return
+        its :class:`~nds_tpu.obs.profile.PlanProfile` — the annotated plan
+        tree (per-node wall/rows/bytes with stable TypeName#k identities),
+        the estimate-vs-actual cardinality audit, and the device-memory
+        watermark block. The result Table rides on ``profile.table`` and
+        is BIT-IDENTICAL to ``sql(query)``: in-core plans walk the same
+        executor eagerly node by node (children memoized, so each node's
+        wall is its own work), streamed plans run the unchanged morsel
+        path and only read counters. One statement only; the standing
+        flag is ``EngineConfig.profile_plans`` (``power --explain``)."""
+        with self._sql_lock:
+            prev = self.config.profile_plans
+            self.config.profile_plans = True
+            try:
+                self._sql_locked(query, backend, label)
+            finally:
+                self.config.profile_plans = prev
+            return self.last_profile
+
     def _sql_locked(self, query: str, backend: Optional[str],
                     label: Optional[str], plan=None) -> Table:
         use_jax = (backend == "jax") if backend else self.config.use_jax
@@ -629,7 +659,11 @@ class Session:
         self.last_exec_stats = {}
         self.last_exec_stats_typed = None
         self._active_label = label or self._auto_label(query)
+        from ..obs.profile import DEVICE_MEM
+        DEVICE_MEM.mark_window()   # per-query device-memory peak window
         _metrics.QUERIES_RUN.inc()
+        if self.config.profile_plans and plan is None:
+            return self._profiled_locked(query, use_jax)
         with TRACER.span("query", label=self._active_label,
                          backend="jax" if use_jax else "numpy"):
             if use_jax:
@@ -668,12 +702,199 @@ class Session:
         import hashlib
         return "q" + hashlib.sha1(query.encode()).hexdigest()[:8]
 
+    # -- EXPLAIN ANALYZE (obs/profile.py) ------------------------------------
+    def _profiled_locked(self, query: str, use_jax: bool) -> Table:
+        """Profiled execution of one statement (config.profile_plans /
+        explain_analyze): a streamable query runs the UNCHANGED morsel
+        path (bit-identity by construction — profiling only reads the
+        counters the stream already computes), everything else walks the
+        plan eagerly node by node through the existing executor. Installs
+        self.last_profile and returns the result Table."""
+        import time as _time
+
+        _metrics.PROFILED_QUERIES.inc()
+        with TRACER.span("query", label=self._active_label,
+                         backend="jax" if use_jax else "numpy",
+                         profiled=True):
+            if use_jax and self.config.out_of_core:
+                t0 = _time.perf_counter()
+                result = self._sql_streaming(query)
+                if result is not None:
+                    prof = self._stream_profile(
+                        result, (_time.perf_counter() - t0) * 1000.0)
+                    return self._finish_profile(prof, result)
+            with TRACER.span("plan", label=self._active_label):
+                plan = Planner(self._catalog()).plan_query(parse_sql(query))
+            prof, result = self._profile_walk(plan, use_jax)
+        return self._finish_profile(prof, result)
+
+    def _finish_profile(self, prof, result: Table) -> Table:
+        """Audit + memory block + metrics for a freshly built profile;
+        installs it as last_profile."""
+        from ..obs import profile as _prof
+
+        prof.findings = _prof.cardinality_audit(
+            prof, self.config.profile_misestimate_ratio)
+        if prof.findings:
+            _metrics.CARDINALITY_MISESTIMATES.inc(len(prof.findings))
+        st = self.last_exec_stats_typed
+        prof.memory = _prof.memory_block(
+            int(self.config.scan_budget_gb * (1 << 30))
+            if self.config.scan_budget_gb > 0 else None)
+        if st is not None and st.mem_peak_bytes is not None:
+            prof.memory["query_peak_bytes"] = st.mem_peak_bytes
+        prof.table = result
+        self.last_profile = prof
+        return result
+
+    def _profile_walk(self, plan, use_jax: bool):
+        """The eager node-by-node profiled walk: children-first execution
+        through the EXISTING executor, so each node's wall measures only
+        its own work (children are memoized) and the root result is the
+        same eager evaluation a first-sighting record pass performs —
+        bit-identical to compiled replay by the engine's record/replay
+        discipline. Per-node rows are exact (alive counts); bytes are the
+        node's device (or host) output footprint."""
+        import contextlib
+        import time as _time
+
+        from ..obs import profile as _prof
+        from ..obs.stats import ExecStats
+
+        labels, children, order = _prof.plan_tree(plan)
+        ests = _prof.estimate_rows(
+            plan, lambda t: self._est_rows.get(t))
+        prof = _prof.PlanProfile(
+            query=self._active_label,
+            backend="jax" if use_jax else "numpy",
+            mode="in-core" if use_jax else "numpy",
+            root=labels[id(plan)])
+        node_rows: dict = {}
+        t_all = _time.perf_counter()
+        if use_jax:
+            import jax as _jax
+
+            from .jax_backend import to_host
+            from .jax_backend.device import device_bytes
+            jexec = self._jax_executor()
+            jexec.query_label = self._active_label
+            jexec.fallback_nodes = []
+            jexec._memo = {}
+            ctx = _jax.default_device(jexec._eager_device) \
+                if jexec._eager_device is not None \
+                else contextlib.nullcontext()
+            with ctx:
+                for node in order:
+                    t0 = _time.perf_counter()
+                    out = jexec.execute(node)
+                    _jax.block_until_ready(out)
+                    # the alive-count sync is profiled-mode work this node
+                    # caused: it stays inside the node's wall, so per-node
+                    # walls sum to the profiled total (>= 90% acceptance)
+                    rows = int(_jax.device_get(out.count()))
+                    wall = (_time.perf_counter() - t0) * 1000.0
+                    lbl = labels[id(node)]
+                    node_rows[lbl] = rows
+                    prof.nodes[lbl] = _prof.NodeStat(
+                        label=lbl, op=type(node).__name__,
+                        detail=_prof.node_detail(node),
+                        est_rows=ests.get(id(node)), rows=rows,
+                        wall_ms=round(wall, 3), bytes=device_bytes(out),
+                        children=children.get(lbl, []))
+            prof.total_ms = round((_time.perf_counter() - t_all) * 1000.0,
+                                  3)
+            result = to_host(out)
+            self.last_fallbacks = list(jexec.fallback_nodes)
+        else:
+            executor = Executor(self.load_table)
+            for node in order:
+                t0 = _time.perf_counter()
+                out = executor.execute(node)
+                wall = (_time.perf_counter() - t0) * 1000.0
+                lbl = labels[id(node)]
+                node_rows[lbl] = out.num_rows
+                prof.nodes[lbl] = _prof.NodeStat(
+                    label=lbl, op=type(node).__name__,
+                    detail=_prof.node_detail(node),
+                    est_rows=ests.get(id(node)), rows=out.num_rows,
+                    wall_ms=round(wall, 3),
+                    bytes=sum(getattr(c.data, "nbytes", 0)
+                              for c in out.columns),
+                    children=children.get(lbl, []))
+            result = out
+        if not prof.total_ms:
+            prof.total_ms = round((_time.perf_counter() - t_all) * 1000.0,
+                                  3)
+        stats = ExecStats(mode="profiled", node_stats=node_rows,
+                          device_ms=round(prof.profiled_ms(), 3),
+                          fallback_reasons=list(self.last_fallbacks))
+        self._finish_exec_stats(stats)
+        return prof, result
+
+    def _stream_profile(self, result: Table, total_ms: float):
+        """Build the streamed-execution profile from the counters the
+        morsel path just recorded (_last_stream_profile): per-group walls
+        land on the group's scan nodes, per-job merge/final walls on the
+        original aggregate nodes, the finalize wall on the root. Row
+        counts are exact (host-side morsel/partial/final counts); nodes
+        the stream never materializes individually carry no wall."""
+        from ..obs import profile as _prof
+
+        rec = self._last_stream_profile or {}
+        plan = rec.get("plan")
+        prof = _prof.PlanProfile(query=self._active_label, backend="jax",
+                                 mode="streaming", total_ms=round(
+                                     total_ms, 3))
+        if plan is None:
+            return prof
+        from .plan import ScanNode
+        labels, children, order = _prof.plan_tree(plan)
+        ests = _prof.estimate_rows(plan, lambda t: self._est_rows.get(t))
+        prof.root = labels[id(plan)]
+        group_rows = {g["table"]: g for g in rec.get("groups", ())}
+        agg_stats = {aid: j for j in rec.get("jobs", ())
+                     for aid in [j["agg_id"]]}
+        walled: set[str] = set()   # group wall lands on ONE scan per table
+        for node in order:
+            lbl = labels[id(node)]
+            ns = _prof.NodeStat(
+                label=lbl, op=type(node).__name__,
+                detail=_prof.node_detail(node),
+                est_rows=ests.get(id(node)),
+                children=children.get(lbl, []))
+            if isinstance(node, ScanNode) and node.table in group_rows:
+                g = group_rows[node.table]
+                ns.rows = g["rows"]
+                if node.table not in walled:
+                    walled.add(node.table)
+                    ns.wall_ms = g["wall_ms"]
+                    ns.bytes = g.get("bytes")
+            elif id(node) in agg_stats:
+                j = agg_stats[id(node)]
+                ns.rows = j["final_rows"]
+                ns.wall_ms = j["wall_ms"]
+            if id(node) == id(plan):
+                ns.rows = result.num_rows
+                ns.wall_ms = (ns.wall_ms or 0.0) + rec.get(
+                    "finalize_ms", 0.0)
+            prof.nodes[lbl] = ns
+        return prof
+
     def _finish_exec_stats(self, stats: ExecStats) -> None:
         """THE single point where a query's execution stats land (both the
         in-core executor path and the streaming path build an ExecStats and
         come through here): installs the typed record, its backward-
         compatible dict view, and rolls the run into the process-wide
         metrics registry."""
+        from ..obs.profile import DEVICE_MEM
+        # device-memory watermarks: the statement's peak window was opened
+        # in _sql_locked; headroom is measured against the HBM scan budget
+        stats.mem_peak_bytes = DEVICE_MEM.window_peak()
+        stats.mem_live_bytes = DEVICE_MEM.live
+        if self.config.scan_budget_gb > 0:
+            stats.mem_headroom_bytes = \
+                int(self.config.scan_budget_gb * (1 << 30)) - \
+                stats.mem_peak_bytes
         if self.config.pallas_ops:
             from .jax_backend import pallas_kernels as _pk
             ops = sorted(_pk.parse_ops(self.config.pallas_ops))
@@ -800,8 +1021,17 @@ class Session:
                 self._stream_cache[query] = sent
 
         plan, jobs, groups = sent["plan"], sent["jobs"], sent["groups"]
+        import time as _time
+
         from .jax_backend.device import decode_stats
         dec0 = decode_stats()
+        # per-run profile collection (cheap: host counters the loop already
+        # computes + one perf_counter pair per group/job) — feeds
+        # ExecStats.node_stats on every streamed run and the full
+        # PlanProfile under EXPLAIN ANALYZE (_stream_profile)
+        stream_rec: dict = {"plan": plan, "groups": [], "jobs": [],
+                            "finalize_ms": 0.0}
+        self._last_stream_profile = stream_rec  # lint: lock-exempt (statement-scoped: written and read under _sql_lock)
         mapping: dict = {}
         total_morsels = 0
         re_records = 0
@@ -826,13 +1056,17 @@ class Session:
                         self._incore_partial(sent["exec"], branch)))
         for group, gstate in zip(groups, sent["gstates"]):
             sinks = [(jobs[ji], partials[ji]) for ji, _bi in group.members]
+            g_t0 = _time.perf_counter()
             out = self._stream_group(group, sent["exec"], gstate, sinks,
                                      prefetch_errs, shard_stats)
             if out is None:
                 with self._lock:
                     self._stream_cache[query] = None
                 return None     # not device-runnable: in-core path
-            morsels_run, rr, ub, sharded, host_ms = out
+            morsels_run, rr, ub, sharded, host_ms, rows_streamed = out
+            stream_rec["groups"].append({
+                "table": group.table, "rows": rows_streamed, "bytes": ub,
+                "wall_ms": round((_time.perf_counter() - g_t0) * 1000, 3)})
             total_morsels += morsels_run
             re_records += rr
             bytes_uploaded += ub
@@ -854,6 +1088,7 @@ class Session:
                 with self._lock:
                     self._stream_cache[query] = None
                 return None
+            j_t0 = _time.perf_counter()
             with TRACER.span("merge.partials", job=ji,
                              parts=len(partials[ji])):
                 merged_arrow = pa.concat_tables(partials[ji],
@@ -866,6 +1101,10 @@ class Session:
                                        out_dtypes=list(job.partial_dtypes))
                 final_sub = job.build_final(mat)
                 sub_res = Executor(self.load_table).execute(final_sub)
+            stream_rec["jobs"].append({
+                "agg_id": id(job.agg), "partial_rows": merged.num_rows,
+                "final_rows": sub_res.num_rows,
+                "wall_ms": round((_time.perf_counter() - j_t0) * 1000, 3)})
             mat_node = MaterializedNode(
                 table=sub_res, label="streamed-agg",
                 out_names=list(job.agg.out_names),
@@ -880,9 +1119,12 @@ class Session:
             else:
                 mapping[id(job.agg)] = mat_node
         final_plan = streaming.substitute_nodes(plan, mapping)
+        f_t0 = _time.perf_counter()
         with TRACER.span("finalize", label=self._active_label,
                          jobs=len(jobs)):
             result = Executor(self.load_table).execute(final_plan)
+        stream_rec["finalize_ms"] = round(
+            (_time.perf_counter() - f_t0) * 1000, 3)
         # scan_passes counts morsel loops (== tables_streamed when
         # shared_scan serves every branch from one pass; == branches_served
         # per-branch without it); lane_spec records which physical lane each
@@ -918,9 +1160,31 @@ class Session:
             sharded_groups=sharded_groups or None,
             collective_bytes=shard_stats.get("collective_bytes"),
             collective_ms=shard_stats.get("collective_ms"),
+            node_stats=self._stream_node_stats(plan, stream_rec, result),
             prefetch_error_details=prefetch_errs,
             fallbacks=self.last_fallbacks))
         return result
+
+    def _stream_node_stats(self, plan, rec: dict, result: Table) -> dict:
+        """{TypeName#k: actual rows} a streamed run records for free —
+        rows streamed per big scan, final group counts per streamed
+        aggregate, result rows at the root. Labels are verify.node_labels
+        over the session's plan, the same identities profiles and
+        verifier findings use (obs/profile.plan_tree)."""
+        from .plan import ScanNode, iter_plan_nodes
+        from .verify import node_labels
+        labels = node_labels(plan)
+        rows_by_table = {g["table"]: g["rows"] for g in rec["groups"]}
+        out: dict = {}
+        for n in iter_plan_nodes(plan):
+            if isinstance(n, ScanNode) and n.table in rows_by_table:
+                out[labels[id(n)]] = rows_by_table[n.table]
+        for j in rec["jobs"]:
+            lbl = labels.get(j["agg_id"])
+            if lbl is not None:     # synthesized semi-join aggs are not
+                out[lbl] = j["final_rows"]   # nodes of the session plan
+        out[labels[id(plan)]] = result.num_rows
+        return out
 
     def _new_stream_executor(self) -> dict:
         """One JaxExecutor (+ morsel slot) shared by every streamed branch
@@ -997,8 +1261,8 @@ class Session:
         shard_map, and one all_gather moves the bounded decomposed
         partials before the unchanged host merge
         (jax_backend/shard_exec.ShardedMorselQuery). Returns (morsels,
-        re_records, bytes_uploaded, sharded, host_decode_ms) or None when
-        some member is not device-runnable."""
+        re_records, bytes_uploaded, sharded, host_decode_ms, rows_streamed)
+        or None when some member is not device-runnable."""
         import threading
 
         from . import streaming
@@ -1022,6 +1286,7 @@ class Session:
         re_records = 0
         count = 0
         bytes_uploaded = 0
+        rows_streamed = 0
 
         def record_first(morsel) -> bool:
             if mesh is not None:
@@ -1206,6 +1471,7 @@ class Session:
                             self.config.stream_compact_rows:
                         plist[:] = [self._combine_partials(job, plist)]
                 count += 1
+                rows_streamed += morsel.num_rows
                 if stage_thread is not None:
                     stage_thread.join()
                     stage_thread = None
@@ -1223,7 +1489,8 @@ class Session:
             current.pop("table", None)
         if count == 0:
             return None   # empty source: the in-core path handles it
-        return count, re_records, bytes_uploaded, mesh is not None, host_ms
+        return (count, re_records, bytes_uploaded, mesh is not None,
+                host_ms, rows_streamed)
 
     def sql_arrow(self, query: str) -> pa.Table:
         return arrow_bridge.to_arrow(self.sql(query))

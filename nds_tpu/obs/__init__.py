@@ -18,6 +18,10 @@ engine could not say which operator in which query burns the chip's time
   cost_analysis FLOPs/bytes, ranked with per-program roofline fractions.
 - :mod:`.stats`   — the typed ``ExecStats`` replacing the untyped
   ``last_exec_stats`` dict (dict view preserved).
+- :mod:`.profile` — EXPLAIN ANALYZE: per-plan-node runtime profiles
+  under the verifier's stable TypeName#k identities, the
+  estimate-vs-actual cardinality audit, and the device-memory watermark
+  accountant (``DEVICE_MEM``) the upload paths write through.
 - :mod:`.log`     — ``logging``-based diagnostics channel with one
   verbosity knob, replacing raw stderr writes.
 """
@@ -26,4 +30,5 @@ from .metrics import METRICS                                     # noqa: F401
 from .flight import FLIGHT                                       # noqa: F401
 from .device_time import PROGRAMS                                # noqa: F401
 from .stats import ExecStats                                     # noqa: F401
+from .profile import DEVICE_MEM, PlanProfile                     # noqa: F401
 from .log import get_logger                                      # noqa: F401

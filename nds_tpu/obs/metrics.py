@@ -284,6 +284,10 @@ def exact_quantile(sorted_vals: list, p: float) -> float:
 #: degrades per-label resolution instead of growing memory unboundedly
 HISTOGRAM_MAX_SERIES = 4096
 
+#: the cardinality-cap fold has been logged already (once per process;
+#: the ``histogram_series_overflow`` counter keeps the full count)
+_OVERFLOW_LOGGED = False
+
 
 _LABEL_BAD = str.maketrans({c: "_" for c in '{}",=\\\n\r\t'})
 
@@ -350,7 +354,10 @@ class MetricsRegistry:
         labels) or a labeled child (``histogram("service_latency_ms",
         tenant="dash", template="a1b2")``). Children inherit the family
         help; past HISTOGRAM_MAX_SERIES labeled series the base series
-        absorbs new label sets (resolution degrades, memory does not).
+        absorbs new label sets (resolution degrades, memory does not) —
+        the fold is counted in ``histogram_series_overflow`` and logged
+        ONCE per process, so a tenant/template cardinality explosion is
+        visible instead of silently flattening the per-label views.
         Label values are sanitized (quotes/separators/newlines ->
         underscore): tenant names are caller-provided."""
         labels = _clean_labels(labels) if labels else labels
@@ -359,6 +366,7 @@ class MetricsRegistry:
             h = self._hists.get(key)
             if h is None:
                 if labels and len(self._hists) >= HISTOGRAM_MAX_SERIES:
+                    self._note_series_overflow(key)
                     return self.histogram(name, help)
                 if not help:
                     base = self._hists.get(name)
@@ -368,6 +376,24 @@ class MetricsRegistry:
             elif help and not h.help:
                 h.help = help
             return h
+
+    def _note_series_overflow(self, key: str) -> None:
+        """A labeled series fell into the base series at the cardinality
+        cap: count every fold (``histogram_series_overflow``) and log the
+        first one — called under the registration lock, so the inc rides
+        the reentrant path (the counter shares this registry's locks)."""
+        global _OVERFLOW_LOGGED
+        c = self._metrics.get("histogram_series_overflow")
+        if isinstance(c, Counter):
+            c.inc()
+        if not _OVERFLOW_LOGGED:
+            _OVERFLOW_LOGGED = True
+            from .log import get_logger
+            get_logger().warning(
+                "histogram label cardinality cap reached "
+                f"({HISTOGRAM_MAX_SERIES} series): new labeled series "
+                f"(first: {key!r}) fold into their base series — "
+                "per-label resolution degrades, memory does not")
 
     def locked(self):
         """The shared value lock, for callers that update several metrics
@@ -635,6 +661,32 @@ RESULT_CACHE_INVALIDATIONS = METRICS.counter(
     "result_cache_invalidations", "result-cache entries dropped for "
     "staleness (table generation moved, TTL expired, or a delta the "
     "entry could not absorb)")
+# EXPLAIN ANALYZE / per-plan-node runtime profiles (obs/profile.py): all
+# exactly zero when profiling is off (the metrics gate pins both
+# strict-zero on its clean, profiling-off workload)
+PROFILED_QUERIES = METRICS.counter(
+    "profiled_queries", "queries executed in profiled (EXPLAIN ANALYZE) "
+    "mode: eager node-by-node walk with per-node wall/rows/bytes, "
+    "bit-identical results (Session.explain_analyze / "
+    "EngineConfig.profile_plans)")
+CARDINALITY_MISESTIMATES = METRICS.counter(
+    "cardinality_misestimates", "estimate-vs-actual cardinality audit "
+    "findings above the misestimate ratio threshold (profiled runs only: "
+    "planner static size assumption vs exact per-node row count)")
+HISTOGRAM_SERIES_OVERFLOW = METRICS.counter(
+    "histogram_series_overflow", "labeled histogram series folded into "
+    "their base series at the HISTOGRAM_MAX_SERIES cardinality cap "
+    "(per-label resolution degraded; logged once per process)")
+# Device-memory watermark accounting (obs/profile.DEVICE_MEM): the live
+# set of tracked device allocations (to_device/pack_table/stage_sharded
+# uploads + the codebook cache) and its process-lifetime peak — compiled-
+# program intermediates are NOT tracked (see DeviceMemTracker)
+DEVICE_LIVE_BYTES = METRICS.gauge(
+    "device_live_bytes", "tracked device-resident bytes currently live "
+    "(uploads + codebook cache; freed buffers subtract)")
+DEVICE_PEAK_BYTES = METRICS.gauge(
+    "device_peak_bytes", "process-lifetime peak of device_live_bytes — "
+    "the high-water mark headroom checks compare to the HBM budget")
 
 # Service latency distributions (histogram families): the base series
 # aggregates every query; the service also records per-(tenant, template)

@@ -99,6 +99,22 @@ class ExecStats:
     #: record to its span subtree in a Chrome-trace/JSONL export (None
     #: outside the service, 0 when tracing was disabled at submit)
     trace_id: Optional[int] = None
+    # -- per-plan-node actuals (obs/profile.py) ------------------------------
+    #: {TypeName#k label: actual row count} — the row counts the engine
+    #: ALREADY computes riding out for free: schedule-check values on the
+    #: compiled path (group counts, join build/probe sizes), morsel/partial/
+    #: final counts on the streamed path, exact per-node counts under
+    #: profiled (EXPLAIN ANALYZE) execution. Labels match verify.py
+    #: findings and PlanProfile nodes (same TypeName#k minting).
+    node_stats: Optional[dict] = None
+    # -- device-memory watermarks (obs/profile.DEVICE_MEM) -------------------
+    #: high-water mark of tracked device bytes during THIS statement
+    mem_peak_bytes: Optional[int] = None
+    #: tracked device bytes live when the statement finished
+    mem_live_bytes: Optional[int] = None
+    #: scan-budget headroom above the statement's peak (budget - peak;
+    #: None when the budget is unbounded)
+    mem_headroom_bytes: Optional[int] = None
     # -- failure observability -----------------------------------------------
     fallback_reasons: list = field(default_factory=list)
     #: EVERY staging-thread failure of the run ("Type: message"), not just
@@ -116,8 +132,11 @@ class ExecStats:
                  if k in last_stats}
         extra = {k: v for k, v in last_stats.items()
                  if k not in _EXECUTOR_FIELDS}
+        # per-node actuals the executor attributed from its capacity-
+        # schedule checks ride the first-class field, not the passthrough
+        node_stats = extra.pop("node_rows", None)
         return cls(fallback_reasons=list(fallbacks or ()),
-                   extra=extra, **known)
+                   node_stats=node_stats, extra=extra, **known)
 
     @classmethod
     def streaming(cls, *, jobs: int, morsels: int, morsel_rows: int,
@@ -137,7 +156,8 @@ class ExecStats:
                   mesh_shards: Optional[int] = None,
                   sharded_groups: Optional[int] = None,
                   collective_bytes: Optional[int] = None,
-                  collective_ms: Optional[float] = None) -> "ExecStats":
+                  collective_ms: Optional[float] = None,
+                  node_stats: Optional[dict] = None) -> "ExecStats":
         """Typed record of one out-of-core (morsel-streamed) execution."""
         return cls(mode="streaming", jobs=jobs, morsels=morsels,
                    morsel_rows=morsel_rows, re_records=re_records,
@@ -155,6 +175,7 @@ class ExecStats:
                    mesh_shards=mesh_shards, sharded_groups=sharded_groups,
                    collective_bytes=collective_bytes,
                    collective_ms=collective_ms,
+                   node_stats=node_stats,
                    prefetch_error_details=list(prefetch_error_details or ()),
                    fallback_reasons=list(fallbacks or ()))
 
@@ -177,7 +198,9 @@ class ExecStats:
                   "host_decode_ms", "mesh_shards", "sharded_groups",
                   "collective_bytes", "collective_ms",
                   "pallas_ops", "pallas_fallback_reason",
-                  "queue_wait_ms", "batched_with", "trace_id"):
+                  "queue_wait_ms", "batched_with", "trace_id",
+                  "node_stats", "mem_peak_bytes", "mem_live_bytes",
+                  "mem_headroom_bytes"):
             v = getattr(self, k)
             if v is not None:
                 out[k] = v

@@ -173,7 +173,8 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
                      verify_plans: str | None = None,
                      pallas_ops: str | None = None,
                      mesh_shards: int | None = None,
-                     trace: str | None = None
+                     trace: str | None = None,
+                     explain: bool = False
                      ) -> list[tuple[str, int, int, int]]:
     """Run every query in the stream; returns (name, start_ms, end_ms, ms).
 
@@ -222,6 +223,13 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
     trace: enable the obs span tracer for the whole stream and write a
     Chrome trace-event file (Perfetto) to this path at the end — the
     engine-internal complement of --profile_folder's jax traces.
+    explain: EXPLAIN ANALYZE mode (EngineConfig.profile_plans): every
+    timed run executes profiled — the annotated per-plan-node tree (time
+    %, rows est->act, bytes, memory peak) prints after each query and the
+    profile JSON lands under <json_summary_folder>/explain/<query>.json
+    for scripts/explain_report.py. Results stay bit-identical; walls
+    measure the eager node-by-node walk, not the compiled steady state,
+    so --explain runs are diagnostics, not benchmark numbers.
     """
     from .check import check_json_summary_folder, check_query_subset_exists
     from .config import maybe_enable_compile_cache
@@ -254,6 +262,8 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
             x.strip() for x in pallas_ops.split(",") if x.strip())
     if mesh_shards is not None:  # --mesh_shards override
         config.mesh_shards = mesh_shards
+    if explain:                  # --explain: profiled timed runs
+        config.profile_plans = True
     session = Session(config)
     setup_tables(session, input_prefix, input_format)
 
@@ -304,7 +314,9 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
         eff_warmup = warmup
         failed_records: set[str] = set()
         use_jax = (backend == "jax") if backend else config.use_jax
-        if precompile and warmup >= 1 and use_jax:
+        # --explain executes eagerly node-by-node: there are no recorded
+        # schedules to precompile, so the cold-start compile pass is moot
+        if precompile and warmup >= 1 and use_jax and not explain:
             t0 = time.perf_counter()
             for name, sql in query_dict.items():
                 if _injected(name) or name in done:
@@ -395,6 +407,19 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
             # every JSON summary (queries_run, cache hits, retries, faults,
             # bytes uploaded... — obs.metrics glossary)
             report.record_metrics(METRICS.delta(metrics_before))
+            if explain and session.last_profile is not None:
+                # EXPLAIN ANALYZE artifacts: annotated tree to stdout, the
+                # serialized profile beside the JSON summaries
+                # (scripts/explain_report.py re-renders either)
+                print(session.last_profile.render(), flush=True)
+                if json_summary_folder:
+                    import json as _json
+                    exp_dir = os.path.join(json_summary_folder, "explain")
+                    os.makedirs(exp_dir, exist_ok=True)
+                    with open(os.path.join(exp_dir, f"{name}.json"),
+                              "w") as f:
+                        _json.dump(session.last_profile.to_dict(), f,
+                                   indent=2)
             elapsed = report.summary["queryTimes"][-1]
             # same latency family the bench/service record into: top-K
             # slow templates rank live from the registry across runners
@@ -561,6 +586,15 @@ def main(argv: list[str] | None = None) -> int:
                         "nds.tpu.mesh_shards. Virtual-device testing: "
                         "XLA_FLAGS=--xla_force_host_platform_device_"
                         "count=N")
+    p.add_argument("--explain", action="store_true",
+                   help="EXPLAIN ANALYZE: run every timed query in "
+                        "profiled mode (eager node-by-node walk, bit-"
+                        "identical results) — prints the annotated plan "
+                        "tree (time%%, rows est->act, bytes, memory peak) "
+                        "per query and writes profile JSONs under "
+                        "<json_summary_folder>/explain/ for "
+                        "scripts/explain_report.py; walls are diagnostic, "
+                        "not the compiled steady state")
     p.add_argument("--trace", default=None, metavar="PATH",
                    help="enable engine span tracing for the whole stream "
                         "and write a Chrome trace-event file here (opens "
@@ -584,7 +618,8 @@ def main(argv: list[str] | None = None) -> int:
                      verify_plans=a.verify_plans,
                      pallas_ops=a.pallas_ops,
                      mesh_shards=a.mesh_shards,
-                     trace=a.trace)
+                     trace=a.trace,
+                     explain=a.explain)
     return 0
 
 

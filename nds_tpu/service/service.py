@@ -491,6 +491,21 @@ class QueryService:
                            deadline_s=deadline_s,
                            backend=backend).result(timeout)
 
+    def explain_analyze(self, query: str, label: Optional[str] = None,
+                        backend: Optional[str] = None):
+        """Live EXPLAIN ANALYZE against the serving session: runs the
+        statement profiled (Session.explain_analyze) on the shared
+        session's statement lock — it waits for the device lane's current
+        statement like any serial dispatch, profiles OUTSIDE the ticket
+        machinery (no admission, no batching: the profile must measure
+        the plan, not the queue), and returns the PlanProfile (result on
+        ``.table``, bit-identical to a served query). Operator surface:
+        diagnostics while the service runs, not a data path."""
+        if not self._running:
+            raise ServiceClosed("service closed")
+        return self.session.explain_analyze(query, backend=backend,
+                                            label=label)
+
     @staticmethod
     def _auto_label(query: str) -> str:
         import hashlib

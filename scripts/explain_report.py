@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""EXPLAIN ANALYZE renderer: annotated plan trees from profile artifacts.
+
+The profiling layer (nds_tpu/obs/profile.py) serializes every profiled
+execution as a PlanProfile JSON — ``power --explain`` writes one per
+query under ``<json_summary_folder>/explain/``, tests and notebooks call
+``Session.explain_analyze(...).to_dict()`` directly, and the service
+exposes ``QueryService.explain_analyze`` live. This tool re-renders any
+of those offline:
+
+- a profile dump (``{"profile_version": 1, "nodes": {...}, ...}``) or a
+  directory of them: the annotated tree (per-node self wall + time%,
+  rows est->act, output bytes), the cardinality-audit findings, and the
+  device-memory watermark line;
+- a power JSON summary (``powerRunReport``): the per-query
+  ``node_stats`` actual-row tables and memory watermarks the normal
+  (unprofiled) runs recorded for free;
+- a bench JSON: its ``memory`` block.
+
+Pure stdlib + nds_tpu.obs.profile (no jax import on the render path).
+
+Usage:
+  python scripts/explain_report.py summary/explain/query9.json
+  python scripts/explain_report.py summary/explain/          # every query
+  python scripts/explain_report.py summary/power_*.json      # node_stats
+  python scripts/explain_report.py BENCH_r05.json            # memory block
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from nds_tpu.obs.profile import PlanProfile  # noqa: E402
+
+
+def _expand(paths: list[str]) -> list[str]:
+    out: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(glob.glob(os.path.join(p, "*.json"))))
+        else:
+            out.append(p)
+    return out
+
+
+def _fmt_mem(block: dict) -> str:
+    def mb(k):
+        v = block.get(k)
+        return f"{v / (1 << 20):.1f}MB" if v is not None else "-"
+    line = (f"memory: live {mb('device_live_bytes')}, "
+            f"peak {mb('device_peak_bytes')}")
+    if block.get("budget_bytes"):
+        line += (f", headroom {mb('headroom_bytes')} of "
+                 f"{mb('budget_bytes')} budget")
+    return line
+
+
+def render_power_summary(doc: dict, path: str) -> None:
+    """Per-query node_stats tables from a power JSON summary: the actual
+    row counts the normal compiled/streamed runs attribute for free
+    (ExecStats.node_stats; exact per-node coverage needs --explain)."""
+    stats = doc.get("execStats") or []
+    name = doc.get("appName") or os.path.basename(path)
+    for st in stats:
+        rows = st.get("node_stats")
+        print(f"{name}: mode={st.get('mode', '?')}", end="")
+        for k in ("mem_peak_bytes", "mem_live_bytes"):
+            if st.get(k) is not None:
+                print(f" {k.replace('mem_', '')}="
+                      f"{st[k] / (1 << 20):.1f}MB", end="")
+        print()
+        if not rows:
+            print("  (no node_stats recorded — run with --explain for "
+                  "full per-node coverage)")
+            continue
+        for lbl, n in sorted(rows.items(), key=lambda kv: -kv[1]):
+            print(f"  {lbl:<28} rows {n}")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="explain_report.py", description=(
+        "render EXPLAIN ANALYZE profiles (annotated plan tree + "
+        "cardinality audit + memory watermarks) from profile dumps, "
+        "power summaries, or bench JSON"))
+    p.add_argument("artifacts", nargs="+",
+                   help="profile JSON(s), a directory of them (power "
+                        "--explain writes <summary>/explain/), power "
+                        "JSON summaries, or a bench JSON")
+    p.add_argument("--findings", type=int, default=8,
+                   help="cardinality-audit findings shown per profile")
+    a = p.parse_args(argv)
+    paths = _expand(a.artifacts)
+    if not paths:
+        print("explain_report: no artifacts found", file=sys.stderr)
+        return 2
+    rc = 0
+    for i, path in enumerate(paths):
+        if i:
+            print()
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"explain_report: {path}: {e}", file=sys.stderr)
+            rc = 2
+            continue
+        if not isinstance(doc, dict):
+            print(f"explain_report: {path}: not a JSON object",
+                  file=sys.stderr)
+            rc = 2
+            continue
+        if "nodes" in doc and ("profile_version" in doc or "root" in doc):
+            print(PlanProfile.from_dict(doc).render(
+                top_findings=a.findings))
+        elif "execStats" in doc:
+            render_power_summary(doc, path)
+        elif "memory" in doc:
+            print(f"{os.path.basename(path)}: {_fmt_mem(doc['memory'])}")
+        else:
+            print(f"explain_report: {path}: no profile, execStats, or "
+                  "memory block", file=sys.stderr)
+            rc = 2
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

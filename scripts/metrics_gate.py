@@ -58,6 +58,11 @@ STRICT_ZERO = (
     # so a hit here means some layer armed it (or served a cached
     # result) without being asked — a behavior regression, never noise
     "result_cache_hits",
+    # EXPLAIN ANALYZE: the gate workload runs with profiling OFF, so any
+    # profiled query, audit finding, or histogram-series fold here means
+    # the disabled path grew profiling work (the zero-cost contract)
+    "profiled_queries", "cardinality_misestimates",
+    "histogram_series_overflow",
 )
 
 #: report-only name suffixes: wall-clock and byte-volume metrics flake

@@ -22,11 +22,21 @@ artifact's histograms + counters in Prometheus text exposition format
 (the live-process form of the same text comes from
 ``METRICS.export_prometheus()``).
 
+``--compare BENCH_r*.json`` reads SEVERAL bench rounds (in argument
+order) and prints the cross-round perf trajectory: per-round wall /
+upload volume / count-shaped counters (compiles, decode sites, host
+decode wall) and the per-query best-latency table, with regressions vs
+the previous round highlighted — the bench history finally has a reader.
+A bench/power artifact carrying EXPLAIN ANALYZE ``profiles`` renders
+their annotated trees too (scripts/explain_report.py is the dedicated
+renderer).
+
 Usage:
   python scripts/obs_report.py SERVICE_r01.json
   python scripts/obs_report.py flight_fault_*.jsonl
   python scripts/obs_report.py bench.json --family query_latency_ms
   python scripts/obs_report.py bench.json --prometheus > metrics.prom
+  python scripts/obs_report.py --compare BENCH_r01.json BENCH_r05.json
 """
 from __future__ import annotations
 
@@ -129,6 +139,86 @@ def print_family(hists: dict, family: str, by: str, top: int) -> None:
                       f"p99={qs[0.99]:>8.1f}")
 
 
+#: cross-round counters worth trending (count-shaped + the two honest
+#: volume/wall numbers); regressions highlight when a round moves past
+#: REGRESS_RATIO of the previous round's value
+COMPARE_METRICS = ("compiles", "program_cache_misses", "replay_mismatches",
+                   "host_fallbacks", "morsels", "decode_sites",
+                   "bytes_uploaded", "host_decode_ms")
+REGRESS_RATIO = 1.2
+
+
+def _per_query_best(doc: dict) -> dict:
+    """{template: best (min) latency ms} from a bench JSON's
+    query_latency_ms histogram series (exact min rides every snapshot)."""
+    out = {}
+    for _key, snap in (doc.get("histograms") or {}).items():
+        if snap.get("name") != "query_latency_ms":
+            continue
+        tpl = snap.get("labels", {}).get("template")
+        if tpl and snap.get("min") is not None:
+            out[tpl] = snap["min"]
+    return out
+
+
+def print_compare(paths: list, docs: list) -> None:
+    """Cross-round perf trajectory over several bench JSONs (argument
+    order = round order): headline wall + upload volume, the trended
+    counters, and per-query best latencies — each cell flagged when it
+    regressed more than REGRESS_RATIO vs the PREVIOUS round."""
+    names = [os.path.basename(p).replace(".json", "") for p in paths]
+    width = max(12, max(len(n) for n in names) + 1)
+
+    def row(label, vals, fmt="{:.1f}", flag_up=True):
+        cells = []
+        prev = None
+        for v in vals:
+            if v is None:
+                cells.append(f"{'-':>{width}}")
+                prev = None
+                continue
+            txt = fmt.format(v)
+            if prev is not None and prev > 0 and \
+                    (v / prev >= REGRESS_RATIO if flag_up
+                     else v / prev <= 1 / REGRESS_RATIO):
+                txt += "!"
+            cells.append(f"{txt:>{width}}")
+            prev = v
+        print(f"{label:<26}" + "".join(cells))
+
+    print("cross-round perf trajectory ('!' = regressed >"
+          f"{REGRESS_RATIO - 1:.0%} vs previous round):")
+    print(f"{'round':<26}" + "".join(f"{n[:width - 1]:>{width}}"
+                                     for n in names))
+    row("wall_ms (slice total)", [d.get("value") for d in docs])
+    row("upload_gb", [d.get("upload_gb") for d in docs], "{:.3f}")
+    row("rows_per_s", [d.get("rows_per_s") for d in docs], "{:.0f}",
+        flag_up=False)
+    for m in COMPARE_METRICS:
+        vals = [(d.get("metrics") or {}).get(m) for d in docs]
+        if any(v for v in vals):
+            row(m, vals, "{:.0f}")
+    templates = sorted({t for d in docs for t in _per_query_best(d)})
+    if templates:
+        print("\nper-query best latency (ms):")
+        for t in templates:
+            row(t, [_per_query_best(d).get(t) for d in docs])
+
+
+def print_profiles(doc: dict, top: int) -> bool:
+    """Render EXPLAIN ANALYZE profiles embedded in an artifact (a
+    ``profiles`` list or dict of PlanProfile.to_dict() payloads)."""
+    profs = doc.get("profiles")
+    if not profs:
+        return False
+    from nds_tpu.obs.profile import PlanProfile
+    items = profs.values() if isinstance(profs, dict) else profs
+    for p in items:
+        print(PlanProfile.from_dict(p).render(top_findings=top))
+        print()
+    return True
+
+
 def print_prometheus(doc: dict) -> None:
     """Prometheus text exposition of an artifact's metrics + histograms
     (offline twin of METRICS.export_prometheus())."""
@@ -152,9 +242,17 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="obs_report.py", description=(
         "histogram/SLO + flight-recorder summarizer for NDS-TPU "
         "observability artifacts"))
-    p.add_argument("artifact", help="JSON with a 'histograms' block "
-                                    "(bench/service_bench/export_json) "
-                                    "or a flight-recorder JSONL dump")
+    p.add_argument("artifact", nargs="+",
+                   help="JSON with a 'histograms' block "
+                        "(bench/service_bench/export_json) or a "
+                        "flight-recorder JSONL dump; several bench "
+                        "JSONs with --compare")
+    p.add_argument("--compare", action="store_true",
+                   help="cross-round perf-trajectory table over several "
+                        "bench JSONs (argument order = round order): "
+                        "per-query wall, bytes uploaded, decode/compile "
+                        "counters, regressions vs the previous round "
+                        "highlighted")
     p.add_argument("--family", default=None,
                    help="histogram family to print (default: every "
                         "family present, service_latency_ms first)")
@@ -168,8 +266,27 @@ def main(argv=None) -> int:
                         "Prometheus text exposition format instead of "
                         "tables")
     a = p.parse_args(argv)
+    if a.compare or len(a.artifact) > 1:
+        docs = []
+        for path in a.artifact:
+            try:
+                with open(path) as f:
+                    doc = json.load(f)
+            except (OSError, json.JSONDecodeError) as e:
+                print(f"obs_report: {path}: {e}", file=sys.stderr)
+                return 2
+            if not isinstance(doc, dict):
+                print(f"obs_report: {path}: not a JSON object",
+                      file=sys.stderr)
+                return 2
+            # driver-recorded rounds wrap the bench JSON under "parsed"
+            if isinstance(doc.get("parsed"), dict):
+                doc = doc["parsed"]
+            docs.append(doc)
+        print_compare(a.artifact, docs)
+        return 0
     try:
-        kind, payload = load(a.artifact)
+        kind, payload = load(a.artifact[0])
     except (ValueError, OSError) as e:
         print(f"obs_report: {e}", file=sys.stderr)
         return 2
@@ -192,6 +309,8 @@ def main(argv=None) -> int:
     if a.prometheus:
         print_prometheus(payload)
         return 0
+    if print_profiles(payload, a.top):
+        print()
     families = [a.family] if a.family else sorted(
         {s.get("name", k) for k, s in hists.items()},
         key=lambda n: (n != "service_latency_ms", n))

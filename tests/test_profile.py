@@ -1,0 +1,396 @@
+"""EXPLAIN ANALYZE (obs/profile.py + Session.explain_analyze).
+
+Contracts pinned here:
+
+- BIT-IDENTITY: profiled execution returns exactly what normal execution
+  returns — in-core (eager walk vs the compiled steady state), streamed
+  (the unchanged morsel path), encoded, sharded (mesh_shards=2 on the
+  conftest's virtual mesh), and the numpy backend;
+- EXACT per-node actual row counts (cross-checked against pyarrow
+  recomputation) under stable TypeName#k labels shared with the plan
+  verifier, and per-node walls summing to ~the profiled total;
+- the normal (unprofiled) paths record ExecStats.node_stats for FREE:
+  schedule-check actuals on the compiled path, morsel/final counts on
+  the streamed path — and they AGREE with the profiled exact counts;
+- the cardinality audit flags static-estimate misestimates (with
+  capacity-ladder bucket drift) and stays silent when estimates hold;
+- device-memory watermark accounting (DEVICE_MEM / ExecStats.mem_*);
+- DISABLED-MODE ZERO COST: profiling off adds no profile counters
+  (count-shaped asserts only — this host's wall-clock flakes);
+- metrics hygiene: every registered metric has a describe() entry, and
+  histogram label-cardinality overflow counts + folds visibly;
+- renderer round trips: PlanProfile to_dict/from_dict/render,
+  scripts/explain_report.py, scripts/obs_report.py --compare.
+"""
+import json
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+import pyarrow.parquet as pq
+import pytest
+
+from nds_tpu.config import EngineConfig
+from nds_tpu.engine import Session
+from nds_tpu.engine.arrow_bridge import to_arrow
+from nds_tpu.obs import metrics as M
+from nds_tpu.obs import profile as P
+
+N_FACT, N_DIM = 40_000, 200
+CHUNK = 4_096
+
+AGG = ("SELECT d.grp, COUNT(*) AS c, SUM(f.qty) AS sq, MAX(f.qty) AS hi "
+       "FROM fact f JOIN dim d ON f.fk = d.dk "
+       "WHERE f.day BETWEEN 10 AND 300 GROUP BY d.grp ORDER BY d.grp")
+
+
+@pytest.fixture(scope="module")
+def data(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("profile")
+    rng = np.random.default_rng(7)
+    fact = pa.table({
+        "fk": pa.array(rng.integers(0, N_DIM, N_FACT), type=pa.int64()),
+        "qty": pa.array(rng.integers(1, 50, N_FACT), type=pa.int64()),
+        # low-cardinality + clustered: the encoded path participates
+        "day": pa.array(np.sort(rng.integers(0, 365, N_FACT))
+                        .astype(np.int64)),
+    })
+    path = os.path.join(str(tmp), "fact.parquet")
+    pq.write_table(fact, path, row_group_size=8192)
+    dim = pa.table({"dk": pa.array(np.arange(N_DIM), type=pa.int64()),
+                    "grp": pa.array((np.arange(N_DIM) % 13)
+                                    .astype(np.int64))})
+    return {"fact": fact, "fact_path": path, "dim": dim,
+            "dir": str(tmp)}
+
+
+def make_session(data, streamed=False, **cfg) -> Session:
+    kw = dict(cfg)
+    if streamed:
+        kw.setdefault("chunk_rows", CHUNK)
+        kw.setdefault("out_of_core_min_rows", 10_000)
+    s = Session(EngineConfig(**kw))
+    if streamed:
+        s.register_parquet("fact", data["fact_path"])
+    else:
+        s.register_arrow("fact", data["fact"])
+    s.register_arrow("dim", data["dim"])
+    return s
+
+
+def assert_identical(a, b):
+    assert to_arrow(a).equals(to_arrow(b))
+
+
+# -- bit-identity: profiled vs normal, every execution shape ----------------
+
+def test_profiled_incore_bit_identity_and_exact_rows(data):
+    s = make_session(data)
+    normal = s.sql(AGG, label="q_incore")          # record
+    s.sql(AGG, label="q_incore")                   # compile+run
+    prof = s.explain_analyze(AGG, label="q_incore")
+    assert_identical(prof.table, normal)
+    assert prof.mode == "in-core" and prof.backend == "jax"
+    # exact actual rows, cross-checked against pyarrow recomputation
+    by_label = {ns.op: ns for ns in prof.nodes.values()}
+    fact, dim = data["fact"], data["dim"]
+    n_filter = pc.sum(pc.and_(
+        pc.greater_equal(fact.column("day"), pa.scalar(10)),
+        pc.less_equal(fact.column("day"), pa.scalar(300)))).as_py()
+    scans = {ns.detail: ns for ns in prof.nodes.values()
+             if ns.op == "ScanNode"}
+    assert scans["fact"].rows == N_FACT
+    assert scans["dim"].rows == N_DIM
+    assert by_label["FilterNode"].rows == n_filter
+    n_groups = len(set(
+        (np.asarray(dim.column("grp")) % 13).tolist()))
+    assert by_label["AggregateNode"].rows == n_groups
+    assert prof.nodes[prof.root].rows == normal.num_rows
+    # every executed node carries a wall + bytes; walls sum to ~total
+    assert all(ns.wall_ms is not None and ns.bytes
+               for ns in prof.nodes.values())
+    assert prof.profiled_ms() <= prof.total_ms * 1.001
+    # tree shape: root reaches every node through children
+    seen, stack = set(), [prof.root]
+    while stack:
+        lbl = stack.pop()
+        if lbl in seen:
+            continue
+        seen.add(lbl)
+        stack.extend(prof.nodes[lbl].children)
+    assert seen == set(prof.nodes)
+    # labels are the verifier's TypeName#k identities
+    assert prof.root.startswith(("ProjectNode", "SortNode"))
+
+
+def test_profiled_wall_attribution_fraction(data):
+    """Per-node walls must explain ~all of the profiled wall (the >=90%
+    acceptance): the gap is pure python glue between nodes."""
+    s = make_session(data)
+    s.sql(AGG, label="q_frac")
+    prof = s.explain_analyze(AGG, label="q_frac")
+    assert prof.profiled_ms() >= 0.9 * prof.total_ms
+
+
+def test_profiled_streamed_bit_identity(data):
+    s = make_session(data, streamed=True)
+    normal = s.sql(AGG, label="q_stream")
+    assert s.last_exec_stats["mode"] == "streaming"
+    prof = s.explain_analyze(AGG, label="q_stream")
+    assert prof.mode == "streaming"
+    assert_identical(prof.table, normal)
+    # streamed profile: exact scan rows + group walls on the scan node
+    scan = next(ns for ns in prof.nodes.values()
+                if ns.op == "ScanNode" and ns.detail == "fact")
+    assert scan.rows == N_FACT
+    assert scan.wall_ms is not None and scan.wall_ms > 0
+    agg = next(ns for ns in prof.nodes.values()
+               if ns.op == "AggregateNode")
+    assert agg.rows == normal.num_rows
+
+
+def test_profiled_encoded_bit_identity(data):
+    s = make_session(data, streamed=True)      # encoded_exec default on
+    normal = s.sql(AGG, label="q_enc")
+    assert s.last_exec_stats.get("enc_spec"), "encoded path must engage"
+    prof = s.explain_analyze(AGG, label="q_enc")
+    assert_identical(prof.table, normal)
+    plain = make_session(data, streamed=True, encoded_exec=False)
+    assert_identical(prof.table, plain.sql(AGG, label="q_enc"))
+
+
+def test_profiled_sharded_bit_identity(data):
+    single = make_session(data, streamed=True)
+    normal = single.sql(AGG, label="q_mesh")
+    s = make_session(data, streamed=True, mesh_shards=2)
+    prof = s.explain_analyze(AGG, label="q_mesh")
+    assert s.last_exec_stats.get("mesh_shards") == 2
+    assert_identical(prof.table, normal)
+
+
+def test_profiled_numpy_backend(data):
+    s = make_session(data)
+    normal = s.sql(AGG, backend="numpy", label="q_np")
+    prof = s.explain_analyze(AGG, backend="numpy", label="q_np")
+    assert prof.backend == "numpy"
+    assert_identical(prof.table, normal)
+    assert prof.nodes[prof.root].rows == normal.num_rows
+
+
+def test_profile_plans_config_flag(data):
+    """EngineConfig.profile_plans: sql() itself runs profiled (the power
+    --explain wiring) and installs last_profile."""
+    s = make_session(data, profile_plans=True)
+    before = M.METRICS.snapshot()
+    out = s.sql(AGG, label="q_flag")
+    assert s.last_profile is not None
+    assert s.last_profile.query == "q_flag"
+    assert_identical(s.last_profile.table, out)
+    assert s.last_exec_stats["mode"] == "profiled"
+    delta = M.METRICS.delta(before)
+    assert delta.get("profiled_queries") == 1
+
+
+# -- node_stats on the NORMAL (unprofiled) paths ----------------------------
+
+def test_compiled_node_stats_agree_with_profiled(data):
+    s = make_session(data)
+    s.sql(AGG, label="q_ns")                     # record
+    rec_stats = s.last_exec_stats.get("node_stats")
+    assert rec_stats, "record pass must attribute schedule decisions"
+    s.sql(AGG, label="q_ns")                     # compile+run
+    s.sql(AGG, label="q_ns")                     # compiled replay
+    assert s.last_exec_stats["mode"] == "compiled"
+    replay_stats = s.last_exec_stats.get("node_stats")
+    assert replay_stats
+    prof = s.explain_analyze(AGG, label="q_ns")
+    exact = {lbl: ns.rows for lbl, ns in prof.nodes.items()}
+    # every attributed label is a real node and its actual count is exact
+    for lbl, rows in replay_stats.items():
+        assert exact.get(lbl) == rows, (lbl, rows, exact.get(lbl))
+    assert replay_stats == rec_stats
+
+
+def test_streamed_node_stats_free_actuals(data):
+    s = make_session(data, streamed=True)
+    out = s.sql(AGG, label="q_sns")
+    ns = s.last_exec_stats.get("node_stats")
+    assert ns
+    scan_rows = [v for k, v in ns.items() if k.startswith("ScanNode")]
+    assert N_FACT in scan_rows
+    root = [v for k, v in ns.items()
+            if k.startswith(("ProjectNode", "SortNode"))]
+    assert out.num_rows in root
+
+
+# -- cardinality audit ------------------------------------------------------
+
+def test_cardinality_audit_flags_stats_lie(data):
+    s = Session(EngineConfig())
+    # lie by 250x: the catalog thinks fact has 10M rows
+    s.register_arrow("fact", data["fact"], est_rows=10_000_000)
+    s.register_arrow("dim", data["dim"])
+    before = M.METRICS.snapshot()
+    prof = s.explain_analyze(AGG, label="q_lie")
+    assert prof.findings, "a 250x stats lie must be flagged"
+    f = next(f for f in prof.findings if f["op"] == "ScanNode")
+    assert f["direction"] == "over" and f["bucket_drift"]
+    assert f["est_rows"] == 10_000_000 and f["rows"] == N_FACT
+    assert M.METRICS.delta(before).get("cardinality_misestimates", 0) \
+        >= len(prof.findings)
+    # honest estimates on the same shape stay quiet at the scan
+    s2 = make_session(data)
+    prof2 = s2.explain_analyze(AGG, label="q_honest")
+    assert not any(f["op"] == "ScanNode" for f in prof2.findings)
+
+
+# -- device-memory watermarks ----------------------------------------------
+
+def test_device_memory_watermarks(data):
+    s = make_session(data, streamed=True)
+    out = s.sql(AGG, label="q_mem")
+    assert out.num_rows
+    st = s.last_exec_stats_typed
+    assert st.mem_peak_bytes and st.mem_peak_bytes > 0
+    assert st.mem_live_bytes is not None
+    # streamed morsel buffers free as the loop advances: the live set at
+    # finish sits below the in-flight peak
+    assert st.mem_live_bytes <= st.mem_peak_bytes
+    assert st.mem_headroom_bytes == \
+        int(s.config.scan_budget_gb * (1 << 30)) - st.mem_peak_bytes
+    assert P.DEVICE_MEM.peak >= st.mem_peak_bytes
+    assert M.DEVICE_PEAK_BYTES.value == P.DEVICE_MEM.peak
+    # the profile carries the same block
+    prof = s.explain_analyze(AGG, label="q_mem")
+    assert prof.memory["query_peak_bytes"] > 0
+    assert prof.memory["headroom_bytes"] == \
+        prof.memory["budget_bytes"] - P.DEVICE_MEM.peak
+
+
+def test_mem_tracker_balance():
+    t = P.DeviceMemTracker()
+    t.add([(1, 100), (2, 50)])
+    t.add([(1, 100)])                 # double add: ignored
+    assert t.live == 150 and t.peak == 150
+    t.mark_window()
+    t.free([(2, 50), (3, 999)])       # untracked id: ignored
+    assert t.live == 100
+    t.add([(4, 500)])
+    assert t.window_peak() == 600 and t.peak == 600
+
+
+# -- disabled-mode zero cost ------------------------------------------------
+
+def test_disabled_mode_adds_no_profile_counters(data):
+    s = make_session(data)
+    before = M.METRICS.snapshot()
+    s.sql(AGG, label="q_off")
+    s.sql(AGG, label="q_off")
+    delta = M.METRICS.delta(before)
+    assert "profiled_queries" not in delta
+    assert "cardinality_misestimates" not in delta
+    assert "histogram_series_overflow" not in delta
+    assert s.last_profile is None
+
+
+# -- metrics hygiene (satellite) --------------------------------------------
+
+def test_every_metric_has_glossary_entry():
+    """describe() completeness: every registered counter/gauge/histogram
+    family must carry a non-empty help string."""
+    missing = [name for name, help_ in M.METRICS.describe().items()
+               if not help_]
+    assert not missing, f"metrics without describe() help: {missing}"
+
+
+def test_histogram_series_overflow_counts(monkeypatch):
+    monkeypatch.setattr(M, "HISTOGRAM_MAX_SERIES",
+                        len(M.METRICS._hists) + 1)
+    base = M.METRICS.histogram("overflow_test_ms", "overflow probe")
+    M.METRICS.histogram("overflow_test_ms", tenant="t0").observe(1.0)
+    before = M.HISTOGRAM_SERIES_OVERFLOW.value
+    folded = M.METRICS.histogram("overflow_test_ms", tenant="t1")
+    assert folded is base            # folded into the base series
+    assert M.HISTOGRAM_SERIES_OVERFLOW.value == before + 1
+    M.METRICS.reset()
+
+
+# -- serialization + renderers ---------------------------------------------
+
+def test_profile_roundtrip_and_render(data, tmp_path):
+    s = make_session(data)
+    prof = s.explain_analyze(AGG, label="q_render")
+    text = prof.render()
+    assert "total" in text and "rows" in text and "memory:" in text
+    d = prof.to_dict()
+    back = P.PlanProfile.from_dict(json.loads(json.dumps(d)))
+    assert back.render() == text
+    assert back.to_dict() == d
+
+
+def test_explain_report_cli(data, tmp_path, capsys):
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "scripts"))
+    import explain_report
+    s = make_session(data)
+    prof = s.explain_analyze(AGG, label="q_cli")
+    pdir = tmp_path / "explain"
+    pdir.mkdir()
+    with open(pdir / "q_cli.json", "w") as f:
+        json.dump(prof.to_dict(), f)
+    assert explain_report.main([str(pdir)]) == 0
+    out = capsys.readouterr().out
+    assert "q_cli" in out and "rows" in out
+    # power-summary mode: node_stats table from a normal run's stats
+    s.sql(AGG, label="q_cli")
+    summary = {"appName": "NDS-TPU q_cli",
+               "execStats": [s.last_exec_stats]}
+    with open(tmp_path / "power_q.json", "w") as f:
+        json.dump(summary, f)
+    assert explain_report.main([str(tmp_path / "power_q.json")]) == 0
+    out = capsys.readouterr().out
+    assert "rows" in out
+    assert explain_report.main([str(tmp_path / "nope.json")]) == 2
+
+
+def test_obs_report_compare(tmp_path, capsys):
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "scripts"))
+    import obs_report
+
+    def round_doc(wall, q9, compiles):
+        return {"schema_version": 3, "value": wall, "upload_gb": 0.5,
+                "rows_per_s": 1000,
+                "metrics": {"compiles": compiles, "morsels": 16},
+                "histograms": {
+                    "query_latency_ms{template=query9}": {
+                        "name": "query_latency_ms",
+                        "labels": {"template": "query9"},
+                        "count": 3, "sum": q9 * 3, "min": q9, "max": q9,
+                        "buckets": [[q9, 3]]}}}
+    p1, p2 = tmp_path / "BENCH_r01.json", tmp_path / "BENCH_r02.json"
+    p1.write_text(json.dumps(round_doc(1000.0, 300.0, 3)))
+    p2.write_text(json.dumps(round_doc(1500.0, 450.0, 9)))
+    assert obs_report.main(["--compare", str(p1), str(p2)]) == 0
+    out = capsys.readouterr().out
+    assert "wall_ms" in out and "query9" in out
+    # regression highlighting: round 2 is >20% slower and tripled compiles
+    assert "1500.0!" in out and "9!" in out and "450.0!" in out
+
+
+# -- live service surface ---------------------------------------------------
+
+def test_service_explain_analyze(data):
+    from nds_tpu.service import QueryService, ServiceConfig
+    s = make_session(data)
+    with QueryService(s, ServiceConfig()) as svc:
+        served = svc.sql(AGG, label="q_svc")
+        prof = svc.explain_analyze(AGG, label="q_svc")
+        assert_identical(prof.table, served)
+        assert prof.nodes[prof.root].rows == served.num_rows
+    from nds_tpu.resilience import AdmissionRejected
+    with pytest.raises(AdmissionRejected):
+        svc.explain_analyze(AGG)

@@ -149,6 +149,11 @@ def _parse_args(argv=None) -> argparse.Namespace:
                         "encodings, EngineConfig.encoded_exec) for A/B "
                         "upload-volume runs; equivalent to "
                         "NDS_TPU_BENCH_ENCODED=0")
+    p.add_argument("--query_log", default=None, metavar="PATH",
+                   help="enable the durable query log (obs/query_log.py) "
+                        "and append one flat JSONL row per completed "
+                        "statement here — the bench run's self-describing "
+                        "artifact for scripts/slo_report.py")
     return p.parse_args(argv)
 
 
@@ -236,6 +241,9 @@ def main(argv=None) -> None:
     if pallas_env:
         config.pallas_ops = tuple(
             x.strip() for x in pallas_env.split(",") if x.strip())
+    if args.query_log:
+        config.query_log = True
+        config.query_log_path = args.query_log
     session = Session(config)
     setup_tables(session, wh_dir, "parquet")
     with open(stream_path) as f:
@@ -399,6 +407,10 @@ def main(argv=None) -> None:
         # execution, EngineConfig.mesh_shards): wall, rows/s, collective
         # volume/time, and which queries actually streamed/sharded
         out["mesh_scaling"] = mesh_scaling
+    if args.query_log:
+        from nds_tpu.obs.query_log import QUERY_LOG
+        QUERY_LOG.flush()
+        out["query_log"] = args.query_log
     if args.trace:
         from nds_tpu.obs.device_time import format_table
         trace_dir = args.trace_dir or BENCH_DIR

@@ -171,9 +171,15 @@ stage_service() {
     # — through batched dispatches, the serial lane, deadline-expired
     # neighbors, and live config toggles; the service-observability suite
     # (histograms, trace propagation, flight recorder) gates here because
-    # its hooks thread through the same service stages
+    # its hooks thread through the same service stages, and the
+    # system-tables + query-log suite (tests/test_system_tables.py:
+    # frozen schemas, ring<->JSONL equivalence, atomic snapshot cuts,
+    # the service's system.* admission bypass with strict-zero counter
+    # pins, rotation/retention, slo_report + metrics_server CLIs) for
+    # the same reason
     (cd "$REPO" && python -m pytest tests/test_service.py \
-        tests/test_obs_service.py -q -m 'not slow')
+        tests/test_obs_service.py tests/test_system_tables.py \
+        -q -m 'not slow')
 }
 
 stage_cache() {

@@ -208,6 +208,25 @@ class EngineConfig:
     # row partials; recompute only delta-touched groups for deletes)
     # instead of invalidating — dashboards stay warm across maintenance
     result_cache_ivm: bool = False
+    # -- durable query log + system tables (obs/query_log.py, obs/
+    #    system_tables.py) ---------------------------------------------------
+    # append one flat row per completed statement to the in-memory ring
+    # system.query_log serves SQL over (O(row) dict flattening at
+    # _finish_exec_stats time, no plan walk). OFF by default: the
+    # disabled path is one branch per statement and zero new counters.
+    # Property: nds.tpu.query_log; runners expose --query_log PATH
+    # (which also sets query_log_path). The system.* catalog itself is
+    # always queryable — only the log rows are opt-in.
+    query_log: bool = False
+    # ring rows kept for live system.query_log SQL
+    query_log_capacity: int = 4096
+    # opt-in durable JSONL sink ("" = ring only): buffered appends with
+    # size-capped rotation (<path>.1, .2, ... monotonic; oldest deleted
+    # past query_log_max_files) so a long service run cannot grow the
+    # log unboundedly
+    query_log_path: str = ""
+    query_log_max_bytes: int = 64 << 20
+    query_log_max_files: int = 4
     # -- resilience (nds_tpu/resilience.py) --------------------------------
     # per-query wall-clock budget in seconds; an overrun abandons the query
     # and records Failed (DeadlineExceeded). 0 = unbounded.

@@ -155,6 +155,24 @@ def strike_shared_program(fp: Optional[str], reason: str = "") -> bool:
     return True
 
 
+def shared_programs_snapshot() -> list:
+    """``system.programs`` rows: one per shared compiled-program cache
+    entry, cut atomically under the registry lock. ``hits`` counts
+    cross-stream adoptions, ``compiles`` the programs published under
+    the fingerprint, ``strikes`` the live quarantine strikes (an entry
+    at QUARANTINE_STRIKES is already evicted, so live strikes are
+    always below the threshold)."""
+    with _SHARED_LOCK:
+        return [{"fingerprint": fp,
+                 "hits": sh.get("adoptions", 0),
+                 "compiles": sh.get("compiles", 0),
+                 "strikes": _PROGRAM_STRIKES.get(fp, 0),
+                 "volatile": bool(sh.get("volatile")),
+                 "nojit": bool(sh.get("nojit")),
+                 "decisions": len(sh.get("decisions", ()))}
+                for fp, sh in sorted(_SHARED_PROGRAMS.items())]
+
+
 def absolve_shared_program(fp: Optional[str]) -> None:
     """A successful run through the shared entry: clear its strikes
     (strikes mark a PERSISTENTLY failing program, not one that hiccuped
@@ -1071,6 +1089,8 @@ class JaxExecutor:
             if sh is None or sh.get("volatile") or sh.get("nojit") \
                     or sh.get("param_dtypes") != pdtypes:
                 return False
+            # system.programs accounting: cross-stream adoptions served
+            sh["adoptions"] = sh.get("adoptions", 0) + 1
             ent = {"plan": sh["plan"], "decisions": list(sh["decisions"]),
                    "scan_keys": sh["scan_keys"], "params": pvalues,
                    "param_dtypes": pdtypes, "cq": sh.get("cq"),
@@ -1135,6 +1155,10 @@ class JaxExecutor:
                     and sh.get("cq") is None \
                     and sh["decisions"] == ent["decisions"]:
                 sh["cq"] = ent["cq"]
+                # system.programs accounting: compiled programs published
+                # under this fingerprint (re-published after cap-merge or
+                # quarantine re-record counts again)
+                sh["compiles"] = sh.get("compiles", 0) + 1
 
     def evict_fp(self, fp: Optional[str]) -> int:
         """Drop every LOCAL plan entry (and batched wrapper) published

@@ -580,11 +580,12 @@ def plan_for_cache(session, sql: str, backend: Optional[str] = None):
 class _Entry:
     __slots__ = ("key", "template_key", "family", "pvalues", "backend",
                  "result", "out_names", "out_dtypes", "tables", "gens",
-                 "stored_at", "plan", "ivm")
+                 "stored_at", "plan", "ivm", "hits")
 
     def __init__(self, key, template_key, family, pvalues, backend,
                  result, out_names, out_dtypes, tables, gens, stored_at,
                  plan, ivm):
+        self.hits = 0          # lookups served (system.result_cache)
         self.key = key
         self.template_key = template_key
         self.family = family
@@ -692,6 +693,7 @@ class ResultCache:
                 if key not in self._entries:
                     del self._aliases[alias]
                 return None
+            entry.hits += 1
         _metrics.RESULT_CACHE_HITS.inc()
         FLIGHT.record("cache_hit", tier="exact", via="text")
         return CacheHit(entry.result, "exact")
@@ -708,6 +710,7 @@ class ResultCache:
             entry = self._check_locked(key)
             if entry is not None:
                 self._aliases[(sql, tag)] = key
+                entry.hits += 1
         if entry is not None:
             _metrics.RESULT_CACHE_HITS.inc()
             FLIGHT.record("cache_hit", tier="exact", via="plan")
@@ -744,6 +747,7 @@ class ResultCache:
             preds = _prove_containment(info, pv, cand_info, cand.pvalues)
             if preds is None:
                 continue
+            cand.hits += 1
             with TRACER.span("cache.subsume",
                              rows=cand.result.num_rows):
                 table = _refilter(cand, preds)
@@ -821,6 +825,27 @@ class ResultCache:
         gen = self.session.table_generation
         return {n.table: gen(n.table) for n in P.iter_plan_nodes(plan)
                 if isinstance(n, P.ScanNode)}
+
+    def snapshot_rows(self) -> list:
+        """``system.result_cache`` rows: one per live entry, cut under
+        the cache lock (entry id is a short stable digest of the full
+        key — operators correlate rows across polls, not decode keys)."""
+        import hashlib
+        with self._lock:
+            out = []
+            for key, e in self._entries.items():
+                digest = hashlib.sha1(repr(key).encode()).hexdigest()[:12]
+                out.append({
+                    "entry": digest,
+                    "template": str(e.template_key)[:16],
+                    "backend": e.backend,
+                    "rows": e.result.num_rows
+                    if e.result is not None else None,
+                    "hits": e.hits,
+                    "stored_at": round(e.stored_at, 3),
+                    "tables": ",".join(e.tables) or None,
+                    "ivm": e.ivm is not None})
+            return out
 
     def _insert_entry(self, sql: str, entry: _Entry) -> None:
         with self._lock:

@@ -95,6 +95,16 @@ class Session:
             # injectable failures — see nds_tpu/resilience.py
             from ..resilience import FAULTS
             FAULTS.configure(self.config.fault_points)
+        if self.config.query_log or self.config.query_log_path:
+            # arm the process-wide durable query log (obs/query_log.py);
+            # clear=False — a second session must not wipe the ring the
+            # first one already filled
+            from ..obs.query_log import QUERY_LOG
+            QUERY_LOG.configure(
+                enabled=True, capacity=self.config.query_log_capacity,
+                path=self.config.query_log_path or None,
+                max_bytes=self.config.query_log_max_bytes,
+                max_files=self.config.query_log_max_files, clear=False)
         self.warehouse = None  # attached via attach_warehouse for DML
         self._loaders: dict[str, Callable[[], Table]] = {}
         self._schemas: dict[str, tuple[list[str], list[str]]] = {}
@@ -137,6 +147,10 @@ class Session:
         # label of the in-flight sql() call (runners pass the query name);
         # compiled programs inherit it for device-time attribution
         self._active_label: str = ""
+        # query-log statement context (_sql_locked sets both per call):
+        # wall start + whether this statement cuts its own log row
+        self._stmt_t0: float = 0.0
+        self._stmt_log: bool = True
         # catalog generation: bumped on any (re-)registration so the device
         # executor's scan cache and compiled plans never serve stale data
         self._generation = 0
@@ -602,7 +616,17 @@ class Session:
         statements are the unit). Note last_exec_stats* describe the last
         COMPLETED statement of ANY caller — concurrent callers wanting
         their own stats use service_run (result + stats atomically).
+
+        ``system.*`` statements (obs/system_tables.py) route to the
+        host-only introspection path WITHOUT taking the statement lock:
+        an operator poll must answer while the device lane is mid-
+        statement, and must never perturb the workload it measures. The
+        disabled-path cost is this one substring branch.
         """
+        if "system." in query or "SYSTEM." in query:
+            result = self._maybe_system_query(query, label)
+            if result is not None:
+                return result
         with self._sql_lock:
             return self._sql_locked(query, backend, label)
 
@@ -628,7 +652,11 @@ class Session:
         and accepts a pre-built plan from the service's planner stage so
         a first-sighting execution skips re-parsing/re-planning."""
         with self._sql_lock:
-            table = self._sql_locked(query, backend, label, plan=plan)
+            # log_row=False: the SERVICE cuts the query-log row per ticket
+            # (tenant/template/phase walls/error class), so the session
+            # must not log a bare duplicate of the same statement
+            table = self._sql_locked(query, backend, label, plan=plan,
+                                     log_row=False)
             return table, self.last_exec_stats_typed
 
     def explain_analyze(self, query: str, backend: Optional[str] = None,
@@ -652,13 +680,86 @@ class Session:
                 self.config.profile_plans = prev
             return self.last_profile
 
+    # -- system tables (obs/system_tables.py) --------------------------------
+    def _maybe_system_query(self, query: str,
+                            label: Optional[str]) -> Optional[Table]:
+        """Route a statement that mentions ``system.`` — returns the
+        result Table when every referenced table is a system table, None
+        when none is (caller proceeds on the normal path; the marker was
+        a literal/comment), and raises on a mix: the host snapshot
+        executor must never pull warehouse-scale user tables."""
+        from ..obs import system_tables as _st
+        ast = parse_sql(query)
+        refs = _st.collect_table_refs(ast)
+        sys_refs = {r for r in refs if _st.is_system_table(r)}
+        if not sys_refs:
+            return None
+        if refs - sys_refs:
+            raise ValueError(
+                "system.* tables cannot join user tables "
+                f"(statement references {sorted(refs - sys_refs)}); "
+                "run the introspection query separately")
+        return self._system_query_ast(ast, sys_refs, label)
+
+    def system_query(self, query: str, label: Optional[str] = None
+                     ) -> Table:
+        """Run one ``system.*`` introspection statement on the HOST
+        executor over atomic registry snapshots — no statement lock, no
+        planner workers, no device dispatch, so it answers during
+        overload, open circuits, and mid-statement device work without
+        perturbing any of them. Raises when the statement touches a
+        non-system table."""
+        from ..obs import system_tables as _st
+        ast = parse_sql(query)
+        refs = _st.collect_table_refs(ast)
+        bad = {r for r in refs if not _st.is_system_table(r)}
+        if bad or not refs:
+            raise ValueError(
+                f"system_query serves system.* tables only (got "
+                f"{sorted(refs) or 'no tables'})")
+        return self._system_query_ast(ast, refs, label)
+
+    def _system_query_ast(self, ast, refs: set,
+                          label: Optional[str]) -> Table:
+        """Plan against the dedicated system catalog and execute on the
+        host backend over per-statement snapshots. Deliberately out of
+        band: no QUERIES_RUN/last_exec_stats/query-log movement — an
+        operator poll must not clobber a concurrent client's stats view
+        or log itself into the surface it is reading."""
+        from ..obs import system_tables as _st
+        _metrics.SYSTEM_QUERIES.inc()
+        with TRACER.span("system_query", label=label or "system"):
+            catalog = Catalog(_st.catalog_entries(), dec_enabled=False,
+                              late_mat=False, verify_plans="off")
+            plan = Planner(catalog).plan_query(ast)
+            # snapshots cut NOW, one per referenced table, each under its
+            # own registry lock (atomic rows; see system_tables docstring)
+            snaps = {name: _st.snapshot_engine_table(name, self)
+                     for name in refs}
+
+            def load(name, columns=None):
+                t = snaps[name]
+                if columns is None:
+                    return t
+                idx = {n: i for i, n in enumerate(t.names)}
+                return Table(list(columns),
+                             [t.columns[idx[c]] for c in columns])
+            return Executor(load).execute(plan)
+
     def _sql_locked(self, query: str, backend: Optional[str],
-                    label: Optional[str], plan=None) -> Table:
+                    label: Optional[str], plan=None,
+                    log_row: bool = True) -> Table:
+        import time as _time
         use_jax = (backend == "jax") if backend else self.config.use_jax
         self.last_fallbacks = []
         self.last_exec_stats = {}
         self.last_exec_stats_typed = None
         self._active_label = label or self._auto_label(query)
+        # query-log context for _finish_exec_stats: statement wall start
+        # + whether THIS statement cuts its own row (the service logs per
+        # ticket instead — richer context, no duplicates)
+        self._stmt_t0 = _time.perf_counter()
+        self._stmt_log = log_row
         from ..obs.profile import DEVICE_MEM
         DEVICE_MEM.mark_window()   # per-query device-memory peak window
         _metrics.QUERIES_RUN.inc()
@@ -688,7 +789,8 @@ class Session:
                 # rides the stats so runners can enumerate the remaining
                 # host/in-core queries per run without scraping status text
                 self._finish_exec_stats(ExecStats.from_executor(
-                    jexec.last_stats, self.last_fallbacks))
+                    jexec.last_stats, self.last_fallbacks),
+                    rows=result.num_rows)
                 return result
             with TRACER.span("plan", label=self._active_label):
                 if plan is None:
@@ -880,12 +982,18 @@ class Session:
             prof.nodes[lbl] = ns
         return prof
 
-    def _finish_exec_stats(self, stats: ExecStats) -> None:
+    def _finish_exec_stats(self, stats: ExecStats,
+                           rows: Optional[int] = None,
+                           log: Optional[bool] = None) -> None:
         """THE single point where a query's execution stats land (both the
         in-core executor path and the streaming path build an ExecStats and
         come through here): installs the typed record, its backward-
-        compatible dict view, and rolls the run into the process-wide
-        metrics registry."""
+        compatible dict view, rolls the run into the process-wide
+        metrics registry, and — when the durable query log is enabled —
+        flattens the record into one O(row) log row (``rows`` carries the
+        result row count when the caller has it; ``log`` overrides the
+        statement's log_row flag — the service passes False for its
+        last-dispatch view and logs per ticket instead)."""
         from ..obs.profile import DEVICE_MEM
         # device-memory watermarks: the statement's peak window was opened
         # in _sql_locked; headroom is measured against the HBM scan budget
@@ -913,6 +1021,15 @@ class Session:
                     stats.pallas_fallback_reason = reason
         self.last_exec_stats_typed = stats
         self.last_exec_stats = stats.to_dict()
+        from ..obs.query_log import QUERY_LOG
+        if QUERY_LOG.enabled and \
+                (self._stmt_log if log is None else log):
+            import time as _time
+            QUERY_LOG.record(
+                stats, source="session", label=self._active_label,
+                wall_ms=round((_time.perf_counter() - self._stmt_t0)
+                              * 1000.0, 3) if self._stmt_t0 else None,
+                rows=rows)
         if stats.fallback_reasons:
             _metrics.HOST_FALLBACKS.inc(len(stats.fallback_reasons))
         if stats.prefetch_error_details:
@@ -1162,7 +1279,7 @@ class Session:
             collective_ms=shard_stats.get("collective_ms"),
             node_stats=self._stream_node_stats(plan, stream_rec, result),
             prefetch_error_details=prefetch_errs,
-            fallbacks=self.last_fallbacks))
+            fallbacks=self.last_fallbacks), rows=result.num_rows)
         return result
 
     def _stream_node_stats(self, plan, rec: dict, result: Table) -> dict:

@@ -71,6 +71,10 @@ class LifecycleConfig:
     #: attempts per phase; failures beyond the first count into the
     #: lifecycle_phase_retries metric
     phase_attempts: int = 1
+    #: durable query log (obs/query_log.py): when set, every statement
+    #: any phase completes appends one flat JSONL row here — the scored
+    #: run's self-describing artifact for scripts/slo_report.py. "" = off
+    query_log: str = ""
     # -- chaos mode ----------------------------------------------------------
     #: run maintenance concurrently with SERVICE-mode query streams under
     #: an armed fault campaign during both throughput rounds
@@ -353,6 +357,12 @@ class LifecycleRunner:
                     "(--resume) to continue it, or use a fresh report_dir")
             self._load_state()
         os.makedirs(self.cfg.report_dir, exist_ok=True)
+        if self.cfg.query_log:
+            # one durable log across every phase of the scored run
+            # (clear=False: a resumed run appends to the same artifact)
+            from .obs.query_log import QUERY_LOG
+            QUERY_LOG.configure(enabled=True, path=self.cfg.query_log,
+                                clear=False)
         plan = [("datagen", self._phase_datagen),
                 ("load", self._phase_load),
                 ("streams", self._phase_streams),
@@ -371,6 +381,10 @@ class LifecycleRunner:
             print(f"lifecycle: phase {name} ...", flush=True)
             self._run_phase(name, fn)
         out = self.score()
+        if self.cfg.query_log:
+            from .obs.query_log import QUERY_LOG
+            QUERY_LOG.flush()
+            print(f"lifecycle: query log {self.cfg.query_log}", flush=True)
         print(f"lifecycle: score {out['metric']} "
               f"(times {out['times']})", flush=True)
         return out

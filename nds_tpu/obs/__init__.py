@@ -22,12 +22,22 @@ engine could not say which operator in which query burns the chip's time
   under the verifier's stable TypeName#k identities, the
   estimate-vs-actual cardinality audit, and the device-memory watermark
   accountant (``DEVICE_MEM``) the upload paths write through.
+- :mod:`.query_log` — durable query log: one flat row per completed
+  statement (bounded ring + opt-in rotating JSONL) — the
+  ``system.query_log`` source.
+- :mod:`.system_tables` — the ``system`` catalog: metrics, histograms,
+  query log, programs, result cache, device memory, flight ring, and
+  catalog generations as SQL-queryable tables on the host-only path.
+- :mod:`.scrape`  — stdlib-http scrape endpoint (``/metrics``,
+  ``/healthz``, ``/query?sql=...``): the first wire-visible operator
+  surface.
 - :mod:`.log`     — ``logging``-based diagnostics channel with one
   verbosity knob, replacing raw stderr writes.
 """
 from .trace import TRACER, span                                  # noqa: F401
 from .metrics import METRICS                                     # noqa: F401
 from .flight import FLIGHT                                       # noqa: F401
+from .query_log import QUERY_LOG                                 # noqa: F401
 from .device_time import PROGRAMS                                # noqa: F401
 from .stats import ExecStats                                     # noqa: F401
 from .profile import DEVICE_MEM, PlanProfile                     # noqa: F401

@@ -65,8 +65,19 @@ class FlightRecorder:
         #: one artifact per window, not one per event
         self.trip_cooldown_s = 30.0
         self._last_trip: dict[str, float] = {}
-        #: paths written by automatic trips (inspection/tests)
+        #: paths written by automatic trips (inspection/tests), oldest
+        #: first — the retention caps below evict from the FRONT
         self.dumps: list[str] = []
+        #: dump retention (a reject-storm or long chaos campaign must not
+        #: grow the dump dir unboundedly): most dump files kept, and a
+        #: total-bytes cap across them — oldest-first eviction, applied
+        #: only to files THIS recorder wrote (self.dumps)
+        self.max_dumps = 200
+        self.max_dump_bytes = 256 << 20
+        self._dump_bytes: dict[str, int] = {}
+        #: monotonic dump index: filenames sort chronologically and stay
+        #: stable under wall-clock steps (seq-stable naming)
+        self._dump_seq = 0
 
     # -- control -------------------------------------------------------------
     def configure(self, enabled: bool = True,
@@ -75,10 +86,16 @@ class FlightRecorder:
                   reject_storm: Optional[int] = None,
                   reject_window_s: Optional[float] = None,
                   trip_cooldown_s: Optional[float] = None,
+                  max_dumps: Optional[int] = None,
+                  max_dump_bytes: Optional[int] = None,
                   clear: bool = True) -> "FlightRecorder":
         """``trip_cooldown_s`` 0 dumps on EVERY trip — chaos campaigns
         set it so an artifact exists per firing (the default 30s keeps a
-        sustained production storm to one dump per window per reason)."""
+        sustained production storm to one dump per window per reason).
+        ``max_dumps``/``max_dump_bytes`` cap automatic-trip dump
+        retention: past either cap the OLDEST dump files this recorder
+        wrote are deleted first (a long campaign keeps its newest
+        evidence; the dir stays bounded)."""
         with self._lock:
             if capacity is not None:
                 self._ring = deque(self._ring, maxlen=capacity)
@@ -90,11 +107,17 @@ class FlightRecorder:
                 self.reject_window_s = reject_window_s
             if trip_cooldown_s is not None:
                 self.trip_cooldown_s = trip_cooldown_s
+            if max_dumps is not None:
+                self.max_dumps = max_dumps
+            if max_dump_bytes is not None:
+                self.max_dump_bytes = max_dump_bytes
             if clear:
                 self._ring.clear()
                 self._rejects.clear()
                 self._last_trip.clear()
                 self.dumps = []
+                self._dump_bytes = {}
+                self._dump_seq = 0
                 self._seq = 0
                 self._epoch = time.monotonic()
             self.enabled = enabled
@@ -153,13 +176,43 @@ class FlightRecorder:
             return None
         safe = "".join(c if c.isalnum() or c in "-_" else "_"
                        for c in reason)   # "circuit:FaultError" etc.
-        path = os.path.join(
-            self.dump_dir,
-            f"flight_{safe}_{int(time.time())}_{self._seq}.jsonl")
+        with self._lock:
+            # monotonic, seq-stable filename: sorting a dump dir by name
+            # is chronological regardless of wall-clock steps, and two
+            # trips inside one second never collide
+            self._dump_seq += 1
+            path = os.path.join(
+                self.dump_dir,
+                f"flight_{self._dump_seq:05d}_{safe}.jsonl")
         self.dump_jsonl(path)
         with self._lock:
             self.dumps.append(path)
+            try:
+                self._dump_bytes[path] = os.path.getsize(path)
+            except OSError:
+                self._dump_bytes[path] = 0
+            evict = self._retention_evict_locked()
+        for old in evict:
+            try:
+                os.remove(old)
+            except OSError:
+                pass
         return path
+
+    def _retention_evict_locked(self) -> list[str]:
+        """Oldest-first eviction past max_dumps/max_dump_bytes: returns
+        the paths to delete (removed from the bookkeeping here, unlinked
+        by the caller outside the lock). Only files this recorder wrote
+        are ever candidates."""
+        evict: list[str] = []
+        total = sum(self._dump_bytes.values())
+        while self.dumps and (
+                len(self.dumps) > self.max_dumps
+                or (total > self.max_dump_bytes and len(self.dumps) > 1)):
+            old = self.dumps.pop(0)
+            total -= self._dump_bytes.pop(old, 0)
+            evict.append(old)
+        return evict
 
     # -- inspection / export -------------------------------------------------
     def events(self) -> list[dict]:

@@ -455,6 +455,17 @@ class MetricsRegistry:
         rows.sort(key=lambda r: (bool(r["labels"]), -(r[top] or 0)))
         return rows
 
+    def rows(self) -> list[tuple]:
+        """(name, kind, value, help) for every counter/gauge, one atomic
+        cut under the shared value lock — the system.metrics snapshot
+        source (typed kind beside the value, unlike :meth:`snapshot`)."""
+        with self._lock:
+            items = sorted(self._metrics.items())
+        with self._values:
+            return [(name,
+                     "counter" if isinstance(m, Counter) else "gauge",
+                     m._value, m.help) for name, m in items]
+
     def delta(self, before: dict[str, Number]) -> dict[str, Number]:
         """Per-unit-of-work view: current snapshot minus ``before``,
         dropping zero rows (counters are process-lifetime totals)."""
@@ -687,6 +698,20 @@ DEVICE_LIVE_BYTES = METRICS.gauge(
 DEVICE_PEAK_BYTES = METRICS.gauge(
     "device_peak_bytes", "process-lifetime peak of device_live_bytes — "
     "the high-water mark headroom checks compare to the HBM budget")
+# System tables + durable query log (obs/system_tables.py, obs/
+# query_log.py): all exactly zero when the log is disabled and no
+# system.* statement runs (the metrics gate pins all three strict-zero
+# on its clean workload — the zero-cost contract for the disabled path)
+SYSTEM_QUERIES = METRICS.counter(
+    "system_queries", "system.* statements served through the host-only "
+    "introspection path (Session.system_query / the service's admission "
+    "bypass / the /query scrape endpoint) — never a device dispatch")
+QUERY_LOG_ROWS = METRICS.counter(
+    "query_log_rows", "statement rows appended to the durable query log "
+    "(in-memory ring + optional JSONL sink; obs/query_log.py)")
+QUERY_LOG_ROTATIONS = METRICS.counter(
+    "query_log_rotations", "query-log JSONL files rolled by the "
+    "size-capped rotation (oldest rotated file deleted past max_files)")
 
 # Service latency distributions (histogram families): the base series
 # aggregates every query; the service also records per-(tenant, template)

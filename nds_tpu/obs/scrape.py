@@ -1,0 +1,169 @@
+"""Live scrape endpoint: the first wire-visible operator surface.
+
+A stdlib-``http.server`` daemon thread serving three read-only routes
+against the process's observability registries and system tables
+(``obs/system_tables.py``) — deliberately ahead of ROADMAP item 3's RPC
+front door, because the operator surface has to exist before the data
+plane goes cross-process:
+
+- ``GET /metrics``  — Prometheus text exposition of the whole metrics
+  registry (``METRICS.export_prometheus()``: counters as ``*_total``,
+  histograms as cumulative ``_bucket``/``_sum``/``_count`` with labels);
+- ``GET /healthz``  — liveness JSON: status, uptime, queries served,
+  queue depth — the probe a load balancer or k8s liveness check hits;
+- ``GET /query?sql=SELECT...`` — run one ``system.*`` statement through
+  the host-only introspection path and return ``{columns, rows}`` JSON.
+  ONLY system tables are queryable over the wire: the endpoint is an
+  operator tool, not a data API, so a statement touching user tables is
+  refused with 403 before any planning happens.
+
+Start via ``ServiceConfig.metrics_port`` (the QueryService owns the
+lifetime), ``scripts/metrics_server.py`` (standalone, can serve a saved
+query-log JSONL), or directly::
+
+    srv = MetricsServer(session, port=0).start()   # 0 = ephemeral
+    ... http://127.0.0.1:{srv.port}/metrics ...
+    srv.stop()
+
+Requests never touch the device lane, the statement lock, or the
+admission queue — scraping a saturated service perturbs nothing (the
+guarantee ``Session.system_query`` provides; pinned by tests).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .metrics import METRICS
+from .log import get_logger
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "nds-tpu-obs/1"
+
+    # the owning MetricsServer installs itself on the server object
+    @property
+    def _owner(self) -> "MetricsServer":
+        return self.server._owner          # type: ignore[attr-defined]
+
+    def log_message(self, fmt, *args):       # quiet: route to the obs log
+        get_logger().debug("scrape: " + fmt % args)
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, code: int, doc: dict) -> None:
+        self._send(code, json.dumps(doc).encode(),
+                   "application/json; charset=utf-8")
+
+    def do_GET(self):                                      # noqa: N802
+        try:
+            parsed = urllib.parse.urlsplit(self.path)
+            route = parsed.path.rstrip("/") or "/"
+            if route == "/metrics":
+                self._send(200, METRICS.export_prometheus().encode(),
+                           "text/plain; version=0.0.4; charset=utf-8")
+            elif route == "/healthz":
+                self._send_json(200, self._owner.health())
+            elif route == "/query":
+                self._do_query(parsed.query)
+            else:
+                self._send_json(404, {"error": f"no route {route!r}",
+                                      "routes": ["/metrics", "/healthz",
+                                                 "/query?sql=..."]})
+        except BrokenPipeError:
+            pass
+        except Exception as e:       # one request must never kill the server
+            try:
+                self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
+            except Exception:
+                pass
+
+    def _do_query(self, query_string: str) -> None:
+        params = urllib.parse.parse_qs(query_string)
+        sql = (params.get("sql") or [""])[0].strip()
+        if not sql:
+            self._send_json(400, {"error": "missing ?sql= parameter"})
+            return
+        session = self._owner.session
+        if session is None:
+            self._send_json(503, {"error": "no session attached"})
+            return
+        try:
+            table = session.system_query(sql, label="scrape")
+        except ValueError as e:
+            # non-system tables / parse-level refusals: the wire surface
+            # serves INTROSPECTION only
+            self._send_json(403, {"error": str(e)})
+            return
+        except Exception as e:
+            self._send_json(400, {"error": f"{type(e).__name__}: {e}"})
+            return
+        from ..engine.arrow_bridge import to_arrow
+        arrow = to_arrow(table)
+        self._send_json(200, {
+            "columns": arrow.column_names,
+            "rows": [list(r.values()) for r in arrow.to_pylist()],
+            "row_count": arrow.num_rows})
+
+
+class MetricsServer:
+    """Owns one ThreadingHTTPServer on a daemon thread.
+
+    ``port=0`` binds an OS-assigned ephemeral port (tests); the bound
+    port reads back from :attr:`port` after :meth:`start`."""
+
+    def __init__(self, session=None, port: int = 0,
+                 host: str = "127.0.0.1"):
+        self.session = session
+        self.host = host
+        self.port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._t0 = time.monotonic()
+
+    def health(self) -> dict:
+        snap = METRICS.snapshot()
+        return {"status": "ok",
+                "uptime_s": round(time.monotonic() - self._t0, 3),
+                "queries_run": snap.get("queries_run", 0),
+                "system_queries": snap.get("system_queries", 0),
+                "service_queue_depth": snap.get("service_queue_depth", 0),
+                "query_failures": snap.get("query_failures", 0)}
+
+    def start(self) -> "MetricsServer":
+        if self._httpd is not None:
+            return self
+        self._httpd = ThreadingHTTPServer((self.host, self.port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd._owner = self        # type: ignore[attr-defined]
+        self.port = self._httpd.server_address[1]
+        self._t0 = time.monotonic()
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="obs-scrape")
+        self._thread.start()
+        get_logger().info(
+            f"scrape endpoint: http://{self.host}:{self.port}/metrics")
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"

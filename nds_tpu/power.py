@@ -174,7 +174,8 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
                      pallas_ops: str | None = None,
                      mesh_shards: int | None = None,
                      trace: str | None = None,
-                     explain: bool = False
+                     explain: bool = False,
+                     query_log: str | None = None
                      ) -> list[tuple[str, int, int, int]]:
     """Run every query in the stream; returns (name, start_ms, end_ms, ms).
 
@@ -223,6 +224,11 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
     trace: enable the obs span tracer for the whole stream and write a
     Chrome trace-event file (Perfetto) to this path at the end — the
     engine-internal complement of --profile_folder's jax traces.
+    query_log: enable the durable query log (obs/query_log.py) and
+    append one flat row per completed statement to this JSONL path
+    (size-capped rotation) — the run leaves a self-describing artifact
+    ``scripts/slo_report.py`` computes SLO attainment from offline, and
+    ``system.query_log`` SQL works live against the same rows.
     explain: EXPLAIN ANALYZE mode (EngineConfig.profile_plans): every
     timed run executes profiled — the annotated per-plan-node tree (time
     %, rows est->act, bytes, memory peak) prints after each query and the
@@ -239,6 +245,9 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
     maybe_enable_compile_cache()
     if trace:
         TRACER.configure(enabled=True)
+    if query_log:
+        from .obs.query_log import QUERY_LOG
+        QUERY_LOG.configure(enabled=True, path=query_log, clear=False)
     if not resume:
         # a RESUMED run re-enters its own summary folder on purpose: the
         # already-written summaries belong to the very run being
@@ -450,6 +459,10 @@ def run_query_stream(input_prefix: str, stream_path: str, time_log: str,
         if trace:
             TRACER.write_chrome_trace(trace)
             print(f"trace: {trace} (open in ui.perfetto.dev)", flush=True)
+        if query_log:
+            from .obs.query_log import QUERY_LOG
+            QUERY_LOG.flush()
+            print(f"query log: {query_log}", flush=True)
     if strict and fallback_queries:
         raise RuntimeError(
             "device fallbacks in strict mode: " + "; ".join(
@@ -600,6 +613,11 @@ def main(argv: list[str] | None = None) -> int:
                         "and write a Chrome trace-event file here (opens "
                         "in ui.perfetto.dev); per-query engine metrics "
                         "land in the JSON summaries either way")
+    p.add_argument("--query_log", default=None, metavar="PATH",
+                   help="enable the durable query log and append one "
+                        "flat JSONL row per completed statement here "
+                        "(size-capped rotation; scripts/slo_report.py "
+                        "reads it offline, system.query_log SQL live)")
     a = p.parse_args(argv)
     sub = a.sub_queries.split(",") if a.sub_queries else None
     inject = a.fault_inject.split(",") if a.fault_inject else None
@@ -619,7 +637,8 @@ def main(argv: list[str] | None = None) -> int:
                      pallas_ops=a.pallas_ops,
                      mesh_shards=a.mesh_shards,
                      trace=a.trace,
-                     explain=a.explain)
+                     explain=a.explain,
+                     query_log=a.query_log)
     return 0
 
 

@@ -147,6 +147,15 @@ class ServiceConfig:
     #: None falls back to the session's EngineConfig.result_cache flag
     #: (still-None/off = no cache, the pre-cache service exactly).
     result_cache: Optional[object] = None
+    #: live scrape endpoint (obs/scrape.MetricsServer): serve /metrics
+    #: (Prometheus exposition), /healthz, and /query?sql=SELECT... over
+    #: the system.* tables for the service's lifetime. None = off;
+    #: 0 = an OS-assigned ephemeral port (tests; the bound port reads
+    #: back from QueryService.metrics_server.port)
+    metrics_port: Optional[int] = None
+    #: bind address for the scrape endpoint (loopback by default: the
+    #: wire surface is an operator tool, not an authenticated API)
+    metrics_host: str = "127.0.0.1"
 
 
 class Ticket:
@@ -173,6 +182,9 @@ class Ticket:
         self.submitted_at = time.perf_counter()
         #: wall between admission and execution start (ms); lands in stats
         self.queue_wait_ms: Optional[float] = None
+        #: per-stage walls for the ticket's query-log row (obs/query_log)
+        self.plan_ms: Optional[float] = None
+        self.exec_ms: Optional[float] = None
         #: per-query ExecStats (queue_wait_ms/batched_with/trace_id incl.)
         self.stats: Optional[ExecStats] = None
         # trace context (set by the service at admission)
@@ -327,6 +339,9 @@ class QueryService:
         self._hold = False                # test/drain hook: park the lane
         self._running = False
         self._threads: list[threading.Thread] = []
+        #: the live scrape endpoint (ServiceConfig.metrics_port); its
+        #: bound port reads back from metrics_server.port once started
+        self.metrics_server = None
         cfg = self.config
         self._breaker = CircuitBreaker(cfg.breaker) \
             if cfg.breaker is not None else None
@@ -361,6 +376,14 @@ class QueryService:
                               name="svc-device-lane")]
         for t in self._threads:
             t.start()
+        if self.config.metrics_port is not None \
+                and self.metrics_server is None:
+            # live scrape endpoint for the service's lifetime: /metrics,
+            # /healthz, /query?sql=... over system.* (obs/scrape.py)
+            from ..obs.scrape import MetricsServer
+            self.metrics_server = MetricsServer(
+                session=self.session, port=self.config.metrics_port,
+                host=self.config.metrics_host).start()
         return self
 
     def close(self, drain: bool = True) -> None:
@@ -383,6 +406,9 @@ class QueryService:
         for t in self._threads:
             t.join(timeout=10)
         self._threads = []
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
+            self.metrics_server = None
 
     def __enter__(self) -> "QueryService":
         return self.start()
@@ -421,6 +447,17 @@ class QueryService:
                 tenant, cfg.default_deadline_s)
         ticket = Ticket(query, label or self._auto_label(query), tenant,
                         Deadline(deadline_s), backend)
+        if "system." in query or "SYSTEM." in query:
+            # system.* introspection bypass: observability must answer
+            # DURING overload and open circuits, so the statement routes
+            # around the breaker gate, the bounded pending set, the
+            # planner workers, and the device lane entirely — it runs
+            # host-only over registry snapshots on the CALLER's thread
+            # (Session.system_query; zero admission/queue/dispatch
+            # counters move, pinned by tests)
+            done = self._try_system(ticket)
+            if done is not None:
+                return done
         if self._breaker is not None:
             # breaker gate BEFORE the pending set: a tripped class sheds
             # load at the door (typed, fatal-until-probe) so the queue
@@ -480,6 +517,26 @@ class QueryService:
                       depth=depth, trace_id=ticket.trace_id or None)
         if cached is not None:
             self._finish_cached(ticket, cached)
+        return ticket
+
+    def _try_system(self, ticket: Ticket) -> Optional[Ticket]:
+        """Serve a system.*-only statement synchronously, out of band.
+        Returns the completed ticket, or None when the statement turned
+        out not to reference system tables (a literal mentioned the
+        prefix — the caller proceeds through normal admission). Genuine
+        system-statement failures (bad SQL, a user-table join) complete
+        the ticket typed — they must not consume admission accounting."""
+        try:
+            table = self.session._maybe_system_query(ticket.query,
+                                                     ticket.label)
+        except Exception as e:
+            ticket.stats = ExecStats(mode="system")
+            ticket.fail(e)
+            return ticket
+        if table is None:
+            return None
+        ticket.stats = ExecStats(mode="system")
+        ticket.finish(table, ticket.stats)
         return ticket
 
     def sql(self, query: str, label: Optional[str] = None,
@@ -546,6 +603,7 @@ class QueryService:
                 self._finish_ticket(ticket, error=e)
                 continue
             plan_ms = (time.perf_counter() - t0) * 1000.0
+            ticket.plan_ms = round(plan_ms, 3)  # lint: lock-exempt (single-owner: the planner worker holds the ticket exclusively until it enqueues to _ready)
             _observe_phase("service_plan_ms", plan_ms, ticket.tenant,
                            ticket.template)
             FLIGHT.record("plan", label=ticket.label, tenant=ticket.tenant,
@@ -749,6 +807,7 @@ class QueryService:
         exec_ms = (time.perf_counter() - t0) * 1000.0
         for t, sp in zip(members, dspans):
             sp.end()
+            t.exec_ms = round(exec_ms, 3)
             _observe_phase("service_exec_ms", exec_ms, t.tenant, t.template)
         device_ms = exec_stats.get("device_ms")
         with _metrics.METRICS.locked():
@@ -804,7 +863,10 @@ class QueryService:
             last = ExecStats(mode="batched", device_ms=device_ms,
                              queue_wait_ms=waits[-1],
                              batched_with=len(members) - 1)
-            session._finish_exec_stats(last)
+            # log=False: every member ticket cuts its own query-log row
+            # at _finish_ticket — this shared last-dispatch view must not
+            # add an unattributed duplicate
+            session._finish_exec_stats(last, log=False)
         return True
 
     def _serve_serial(self, ticket: Ticket) -> None:
@@ -847,8 +909,8 @@ class QueryService:
         if self.config.quarantine and ticket.fp is not None:
             from ..engine.jax_backend.executor import absolve_shared_program
             absolve_shared_program(ticket.fp)
-        _observe_phase("service_exec_ms",
-                       (time.perf_counter() - t0) * 1000.0,
+        ticket.exec_ms = round((time.perf_counter() - t0) * 1000.0, 3)
+        _observe_phase("service_exec_ms", ticket.exec_ms,
                        ticket.tenant, ticket.template)
         if stats is None:
             stats = ExecStats(mode="host")
@@ -935,6 +997,20 @@ class QueryService:
         ticket.close_stage_spans(error=err_name)
         latency_ms = round(
             (time.perf_counter() - ticket.submitted_at) * 1000.0, 3)
+        from ..obs.query_log import QUERY_LOG
+        if QUERY_LOG.enabled:
+            # the ticket's durable query-log row: the service path logs
+            # with full context (tenant/template/phase walls/error class)
+            # — the session's own append is suppressed for service
+            # statements, so this is the one row per ticket
+            QUERY_LOG.record(
+                stats, source="service", label=ticket.label,
+                tenant=ticket.tenant, template=ticket.template,
+                trace_id=ticket.trace_id or None, wall_ms=latency_ms,
+                queue_ms=ticket.queue_wait_ms, plan_ms=ticket.plan_ms,
+                exec_ms=ticket.exec_ms, status=err_name,
+                error=error,
+                rows=getattr(result, "num_rows", None))
         if error is not None:
             ticket.fail(error)
             FLIGHT.record("error", label=ticket.label,

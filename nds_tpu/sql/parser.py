@@ -314,6 +314,15 @@ class _Parser:
             alias = self.ident()
             return SubqueryRef(q, alias)
         name = self.ident()
+        # qualified (catalog-dotted) table name: system.query_log etc. —
+        # the parts join into ONE catalog key, same token shapes as the
+        # dotted column reference below
+        while self.at_op(".") and (
+                self.peek(1).kind == "IDENT"
+                or (self.peek(1).kind == "KW"
+                    and self.peek(1).value in _NONRESERVED)):
+            self.next()
+            name = f"{name}.{self.ident()}"
         alias = None
         if self.accept_kw("as"):
             alias = self.ident()

@@ -63,6 +63,11 @@ STRICT_ZERO = (
     # the disabled path grew profiling work (the zero-cost contract)
     "profiled_queries", "cardinality_misestimates",
     "histogram_series_overflow",
+    # system tables + durable query log: the gate workload runs with the
+    # log DISABLED and issues no system.* statement, so any row, file
+    # rotation, or served introspection query here means the disabled
+    # path grew work (one branch per statement is the whole budget)
+    "system_queries", "query_log_rows", "query_log_rotations",
 )
 
 #: report-only name suffixes: wall-clock and byte-volume metrics flake

@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Standalone scrape endpoint over the system tables.
+
+Serves ``/metrics`` (Prometheus text exposition), ``/healthz``, and
+``/query?sql=SELECT...`` (system.* tables only) from a stdlib
+``http.server`` — the same :class:`nds_tpu.obs.scrape.MetricsServer` a
+live service starts via ``ServiceConfig.metrics_port``, runnable on its
+own for two operator workflows:
+
+- **post-mortem**: point it at a saved query-log JSONL (``--query_log``)
+  and query the run's statement rows over the wire exactly as if the
+  producing process were still alive;
+- **sidecar demo / smoke**: bind an ephemeral port (``--port 0``), let a
+  scraper or curl hit it, ctrl-C to stop.
+
+The first stdout line is ``serving on http://HOST:PORT`` (flushed), so
+harnesses that spawn this script can read the bound ephemeral port.
+
+Usage:
+  python scripts/metrics_server.py --port 9090
+  python scripts/metrics_server.py --port 0 --query_log run/query_log.jsonl
+  curl "http://127.0.0.1:9090/query?sql=SELECT+tenant,wall_ms+FROM+system.query_log"
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="metrics_server.py", description=(
+        "serve /metrics, /healthz, and /query?sql= (system.* tables) "
+        "over HTTP from this process's observability registries"))
+    p.add_argument("--port", type=int, default=8900,
+                   help="bind port (0 = OS-assigned ephemeral; the bound "
+                        "port prints on the first stdout line)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--query_log", default=None, metavar="PATH",
+                   help="replay a saved query-log JSONL into the ring so "
+                        "system.query_log serves the offline run's rows")
+    a = p.parse_args(argv)
+
+    from nds_tpu.config import EngineConfig
+    from nds_tpu.engine import Session
+    from nds_tpu.obs.query_log import QUERY_LOG, read_jsonl
+    from nds_tpu.obs.scrape import MetricsServer
+
+    if a.query_log:
+        rows = read_jsonl(a.query_log)
+        QUERY_LOG.configure(enabled=True, capacity=max(1, len(rows)),
+                            clear=True)
+        n = QUERY_LOG.load_rows(rows)
+        print(f"loaded {n} query-log rows from {a.query_log}",
+              file=sys.stderr)
+    # host-only session: /query plans against the system catalog and the
+    # host executor — no jax initialization, no device
+    session = Session(EngineConfig(use_jax=False))
+    srv = MetricsServer(session=session, port=a.port, host=a.host).start()
+    print(f"serving on {srv.url}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -161,18 +161,21 @@ def _per_query_best(doc: dict) -> dict:
     return out
 
 
-def print_compare(paths: list, docs: list) -> None:
+def print_compare(paths: list, docs: list) -> list[str]:
     """Cross-round perf trajectory over several bench JSONs (argument
     order = round order): headline wall + upload volume, the trended
     counters, and per-query best latencies — each cell flagged when it
-    regressed more than REGRESS_RATIO vs the PREVIOUS round."""
+    regressed more than REGRESS_RATIO vs the PREVIOUS round. Returns
+    the flagged row labels (``"compiles@BENCH_r05"``) so ``--gate`` can
+    fail CI on them."""
     names = [os.path.basename(p).replace(".json", "") for p in paths]
     width = max(12, max(len(n) for n in names) + 1)
+    flagged: list[str] = []
 
     def row(label, vals, fmt="{:.1f}", flag_up=True):
         cells = []
         prev = None
-        for v in vals:
+        for i, v in enumerate(vals):
             if v is None:
                 cells.append(f"{'-':>{width}}")
                 prev = None
@@ -182,6 +185,7 @@ def print_compare(paths: list, docs: list) -> None:
                     (v / prev >= REGRESS_RATIO if flag_up
                      else v / prev <= 1 / REGRESS_RATIO):
                 txt += "!"
+                flagged.append(f"{label}@{names[i]}")
             cells.append(f"{txt:>{width}}")
             prev = v
         print(f"{label:<26}" + "".join(cells))
@@ -203,6 +207,17 @@ def print_compare(paths: list, docs: list) -> None:
         print("\nper-query best latency (ms):")
         for t in templates:
             row(t, [_per_query_best(d).get(t) for d in docs])
+    return flagged
+
+
+def gate_flags(flagged: list[str], allow: list[str]) -> list[str]:
+    """--gate verdict: flags not waived by --allow. A waiver matches the
+    bare row label ("compiles", "query3") or the exact flag cell
+    ("compiles@BENCH_r05") — waive the known intentional change, keep
+    gating everything else."""
+    allowed = {a.strip() for a in allow if a.strip()}
+    return [f for f in flagged
+            if f not in allowed and f.split("@", 1)[0] not in allowed]
 
 
 def print_profiles(doc: dict, top: int) -> bool:
@@ -253,6 +268,16 @@ def main(argv=None) -> int:
                         "per-query wall, bytes uploaded, decode/compile "
                         "counters, regressions vs the previous round "
                         "highlighted")
+    p.add_argument("--gate", action="store_true",
+                   help="with --compare: exit 1 when any '!'-flagged "
+                        ">20%% regression is present (the cross-round "
+                        "reader can FAIL CI instead of only printing "
+                        "flags); waive known-intentional rows with "
+                        "--allow")
+    p.add_argument("--allow", default="",
+                   help="comma list of waived rows for --gate: a bare "
+                        "row label ('compiles', 'query3') waives it in "
+                        "every round, 'label@ROUND' one specific cell")
     p.add_argument("--family", default=None,
                    help="histogram family to print (default: every "
                         "family present, service_latency_ms first)")
@@ -283,7 +308,21 @@ def main(argv=None) -> int:
             if isinstance(doc.get("parsed"), dict):
                 doc = doc["parsed"]
             docs.append(doc)
-        print_compare(a.artifact, docs)
+        flagged = print_compare(a.artifact, docs)
+        if a.gate:
+            offending = gate_flags(flagged, a.allow.split(","))
+            if offending:
+                for f in offending:
+                    print(f"obs_report: GATE regression {f}",
+                          file=sys.stderr)
+                print(f"obs_report: GATE FAIL ({len(offending)} "
+                      "regressions; waive intentional ones with "
+                      "--allow)", file=sys.stderr)
+                return 1
+            print("obs_report: GATE OK "
+                  f"({len(flagged)} flags, all waived)" if flagged
+                  else "obs_report: GATE OK (no regressions)",
+                  file=sys.stderr)
         return 0
     try:
         kind, payload = load(a.artifact[0])

@@ -66,6 +66,13 @@ def main(argv=None) -> int:
                         "device.put,jax.compile,jax.execute,query.run)")
     p.add_argument("--chaos_times", type=int, default=2,
                    help="firings cap per armed chaos spec")
+    p.add_argument("--query_log", nargs="?", const="", default=None,
+                   metavar="PATH",
+                   help="enable the durable query log: one flat JSONL "
+                        "row per completed statement across every phase "
+                        "(bare --query_log defaults to "
+                        "<report_dir>/query_log.jsonl); "
+                        "scripts/slo_report.py reads it offline")
     p.add_argument("--json", default=None, metavar="PATH",
                    help="also write the final {times, metric} block here")
     a = p.parse_args(argv)
@@ -80,7 +87,10 @@ def main(argv=None) -> int:
         warmup=a.warmup, rngseed=a.rngseed,
         throughput_mode=a.throughput_mode, stream_timeout=a.stream_timeout,
         phase_attempts=a.phase_attempts, chaos=a.chaos,
-        chaos_times_per_point=a.chaos_times)
+        chaos_times_per_point=a.chaos_times,
+        query_log=(a.query_log if a.query_log is not None and a.query_log
+                   else (os.path.join(a.report_dir, "query_log.jsonl")
+                         if a.query_log is not None else "")))
     if a.chaos_points:
         kwargs["chaos_points"] = tuple(
             x.strip() for x in a.chaos_points.split(",") if x.strip())

@@ -1,0 +1,197 @@
+#!/usr/bin/env python
+"""Per-tenant SLO report over a saved query log — computed BY the engine.
+
+Reads one or more query-log JSONL files (``--query_log`` on power/bench/
+run_lifecycle, ``ServiceConfig``-driven service runs, or a rotated set
+``log.jsonl.1 log.jsonl.2 log.jsonl``), replays the rows into the
+process query-log ring, and computes the report by running SQL over
+``system.query_log`` through the engine's own host-only introspection
+path — the PyTond move ("on the shoulders of databases"): the analysis
+runs INSIDE the engine the log came from, so this script exercises
+exactly the operator surface a live ``/query?sql=`` scrape hits.
+
+Reported per tenant (and overall):
+
+- request count, error count/classes, exact p50/p95/p99 wall latency
+  (exact — the log holds every row, unlike the ~12%-bounded histogram
+  quantiles a live registry serves);
+- SLO attainment: fraction of ok-status rows completing within
+  ``--slo_ms``, against ``--target`` (e.g. 0.99 = "99% of requests under
+  500 ms");
+- multi-window burn rates: for each ``--windows`` span ending at the
+  log's last row, ``(bad fraction in window) / (1 - target)`` — the
+  standard error-budget burn multiple (1.0 = burning exactly the
+  budget; >>1 = paging territory; the 5m/1h pair is the classic
+  fast+slow multiwindow alert input).
+
+Usage:
+  python scripts/slo_report.py run/query_log.jsonl
+  python scripts/slo_report.py log.jsonl --slo_ms 500 --target 0.99 \
+      --windows 300,3600 --json slo.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from nds_tpu.obs.metrics import exact_quantile          # noqa: E402
+from nds_tpu.obs.query_log import QUERY_LOG, read_jsonl  # noqa: E402
+
+
+def _fetch(session, sql: str) -> list[dict]:
+    """Run one system.* statement and return its rows as dicts — the
+    same host-only path the live scrape endpoint serves."""
+    from nds_tpu.engine.arrow_bridge import to_arrow
+    return to_arrow(session.system_query(sql, label="slo_report")
+                    ).to_pylist()
+
+
+def _sql_count(session, where: str = "") -> dict[str, int]:
+    """{tenant: count} via engine SQL (tenant NULL folds to '')."""
+    rows = _fetch(session, "SELECT tenant, COUNT(*) AS n "
+                           f"FROM system.query_log {where} "
+                           "GROUP BY tenant")
+    return {(r["tenant"] or ""): r["n"] for r in rows}
+
+
+def build_report(session, slo_ms: float, target: float,
+                 windows: list[float]) -> dict:
+    total = _sql_count(session)
+    ok = _sql_count(session, "WHERE status = 'ok'")
+    good = _sql_count(session,
+                      f"WHERE status = 'ok' AND wall_ms <= {slo_ms}")
+    # exact percentiles need the raw samples; fetch them through the same
+    # SQL surface (one pass, grouped host-side)
+    raw = _fetch(session, "SELECT tenant, status, wall_ms, ts "
+                          "FROM system.query_log")
+    by_tenant: dict[str, list[float]] = {}
+    for r in raw:
+        if r["wall_ms"] is not None:
+            by_tenant.setdefault(r["tenant"] or "", []).append(
+                r["wall_ms"])
+    t_end = max((r["ts"] for r in raw if r["ts"] is not None), default=0.0)
+
+    def slice_rows(tenant, since):
+        return [r for r in raw
+                if (r["tenant"] or "") == tenant
+                and (r["ts"] or 0) >= since]
+
+    tenants = sorted(total)
+    out_rows = []
+    budget = max(1e-9, 1.0 - target)
+    for tenant in tenants + ["(all)"]:
+        if tenant == "(all)":
+            n = sum(total.values())
+            n_ok = sum(ok.values())
+            n_good = sum(good.values())
+            lat = sorted(x for v in by_tenant.values() for x in v)
+        else:
+            n = total.get(tenant, 0)
+            n_ok = ok.get(tenant, 0)
+            n_good = good.get(tenant, 0)
+            lat = sorted(by_tenant.get(tenant, []))
+        if not n:
+            continue
+        attain = n_good / n
+        row = {"tenant": tenant, "count": n, "errors": n - n_ok,
+               "p50_ms": round(exact_quantile(lat, 0.50), 2),
+               "p95_ms": round(exact_quantile(lat, 0.95), 2),
+               "p99_ms": round(exact_quantile(lat, 0.99), 2),
+               "attainment": round(attain, 5),
+               "met": attain >= target,
+               "burn": {}}
+        for w in windows:
+            if tenant == "(all)":
+                win = [r for r in raw if (r["ts"] or 0) >= t_end - w]
+            else:
+                win = slice_rows(tenant, t_end - w)
+            bad = sum(1 for r in win
+                      if r["status"] != "ok"
+                      or (r["wall_ms"] or 0) > slo_ms)
+            row["burn"][_wname(w)] = \
+                round((bad / len(win)) / budget, 3) if win else 0.0
+        out_rows.append(row)
+    return {"slo_ms": slo_ms, "target": target,
+            "windows_s": list(windows), "rows": out_rows}
+
+
+def _wname(w: float) -> str:
+    if w % 3600 == 0:
+        return f"{int(w // 3600)}h"
+    if w % 60 == 0:
+        return f"{int(w // 60)}m"
+    return f"{int(w)}s"
+
+
+def print_report(rep: dict) -> None:
+    wnames = [_wname(w) for w in rep["windows_s"]]
+    head = (f"{'tenant':<16} {'count':>7} {'errors':>7} {'p50':>9} "
+            f"{'p95':>9} {'p99':>9} {'attain':>8} {'met':>4}"
+            + "".join(f" {('burn_' + n):>9}" for n in wnames))
+    print(f"SLO: {rep['target']:.2%} of requests <= {rep['slo_ms']} ms "
+          "(burn = bad-fraction / error-budget; 1.0 = budget-rate)")
+    print(head)
+    print("-" * len(head))
+    for r in rep["rows"]:
+        print(f"{r['tenant'] or '(none)':<16} {r['count']:>7} "
+              f"{r['errors']:>7} {r['p50_ms']:>9.1f} {r['p95_ms']:>9.1f} "
+              f"{r['p99_ms']:>9.1f} {r['attainment']:>8.4f} "
+              f"{'yes' if r['met'] else 'NO':>4}"
+              + "".join(f" {r['burn'][n]:>9.2f}" for n in wnames))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="slo_report.py", description=(
+        "per-tenant SLO attainment + multi-window burn rates computed "
+        "by running SQL over a saved query log (system.query_log)"))
+    p.add_argument("log", nargs="+",
+                   help="query-log JSONL file(s); pass a rotated set in "
+                        "filename order (lexicographic = chronological)")
+    p.add_argument("--slo_ms", type=float, default=1000.0,
+                   help="latency SLO threshold in ms (default 1000)")
+    p.add_argument("--target", type=float, default=0.99,
+                   help="attainment target in [0,1] (default 0.99)")
+    p.add_argument("--windows", default="300,3600",
+                   help="comma list of burn-rate window spans in seconds "
+                        "(default 300,3600 = the classic 5m+1h pair)")
+    p.add_argument("--json", default=None, metavar="PATH",
+                   help="also write the report JSON here")
+    a = p.parse_args(argv)
+
+    rows = []
+    for path in a.log:
+        try:
+            rows.extend(read_jsonl(path))
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"slo_report: {path}: {e}", file=sys.stderr)
+            return 2
+    if not rows:
+        print("slo_report: no rows in the given log(s)", file=sys.stderr)
+        return 2
+    # replay the saved rows into the ring, then let the ENGINE do the
+    # analysis over system.query_log (host-only, no device, no jax init)
+    QUERY_LOG.configure(enabled=True, capacity=len(rows), clear=True)
+    QUERY_LOG.load_rows(rows)
+    from nds_tpu.config import EngineConfig
+    from nds_tpu.engine import Session
+    session = Session(EngineConfig(use_jax=False))
+    windows = [float(x) for x in a.windows.split(",") if x.strip()]
+    rep = build_report(session, a.slo_ms, a.target, windows)
+    rep["source"] = [os.path.basename(x) for x in a.log]
+    rep["rows_read"] = len(rows)
+    print_report(rep)
+    if a.json:
+        os.makedirs(os.path.dirname(a.json) or ".", exist_ok=True)
+        with open(a.json, "w") as f:
+            json.dump(rep, f, indent=2, sort_keys=True)
+        print(f"slo_report: wrote {a.json}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

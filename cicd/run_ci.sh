@@ -76,6 +76,16 @@
 #                100-client campaign and the real SF0.001 kill+resume /
 #                chaos lifecycle runs carry the slow marker and run in
 #                the full `test` stage
+#   txn        - transactional warehouse tier-1: crash-consistent
+#                manifest writes (8-reader torn-read hunt), atomic
+#                multi-table commits + rollback + recovery over the
+#                _snapshots log, snapshot-pinned reads (read-your-writes
+#                writer vs pinned readers, AS OF time travel, rollback
+#                CLI, result-cache snapshot keys, system.snapshots), and
+#                the seeded chaos-mid-DML campaign through a live
+#                QueryService (tests/test_txn.py); the SIGKILL-between-
+#                table-commits subprocess run carries the slow marker
+#                and runs in the full `test` stage
 #   metrics_gate - diff the deterministic gate workload's COUNT-shaped
 #                engine counters (compiles, cache hits, morsels, batch
 #                sizes...) against cicd/metrics_baseline.json with
@@ -200,6 +210,14 @@ stage_chaos() {
         tests/test_lifecycle.py -q -m 'not slow')
 }
 
+stage_txn() {
+    # the transactional warehouse's headline invariant, verified: no
+    # torn manifest, no cross-table blend of two warehouse versions, and
+    # every kill window (fault-aborted commits, dead-writer recovery)
+    # lands on exactly the pre- or post-commit snapshot
+    (cd "$REPO" && python -m pytest tests/test_txn.py -q -m 'not slow')
+}
+
 stage_metrics_gate() {
     # count-shaped counter diff vs the checked-in baseline: compiles,
     # cache hits, morsel/batch counts must stay in band on the fixed
@@ -232,16 +250,16 @@ run_stage() {
 }
 
 case "${1:-all}" in
-    native|resilience|static|planner|encoded|kernels|mesh|service|cache|chaos|metrics_gate|test|bench)
+    native|resilience|static|planner|encoded|kernels|mesh|service|cache|chaos|txn|metrics_gate|test|bench)
         run_stage "$1" ;;
     all)
         total0=$SECONDS
         for s in native resilience static planner encoded kernels mesh \
-                 service cache chaos metrics_gate test bench; do
+                 service cache chaos txn metrics_gate test bench; do
             run_stage "$s"
         done
         echo "stage all: $((SECONDS - total0))s" ;;
-    --list)     echo "native resilience static planner encoded kernels mesh service cache chaos metrics_gate test bench all" ;;
-    *) echo "usage: run_ci.sh [native|resilience|static|planner|encoded|kernels|mesh|service|cache|chaos|metrics_gate|test|bench|all|--list]" >&2
+    --list)     echo "native resilience static planner encoded kernels mesh service cache chaos txn metrics_gate test bench all" ;;
+    *) echo "usage: run_ci.sh [native|resilience|static|planner|encoded|kernels|mesh|service|cache|chaos|txn|metrics_gate|test|bench|all|--list]" >&2
        exit 2 ;;
 esac

@@ -27,9 +27,21 @@ seeded — so two runs of one seed arm the same schedule and, with certain
 (p=1, times-capped) specs, fire the same counts regardless of thread
 interleaving. With one client the whole flight-event sequence replays.
 
-``scripts/chaos_bench.py`` drives a 100-client campaign with all six
-points armed and records ``CHAOS_r01.json``; the CI ``chaos`` stage runs
+``scripts/chaos_bench.py`` drives a 100-client campaign with every
+point armed and records ``CHAOS_r01.json``; the CI ``chaos`` stage runs
 a small seeded campaign at ~8 clients (tests/test_chaos.py).
+
+The TRANSACTIONAL campaign (``run_txn_campaign``) points the same
+machinery at a live warehouse: a writer thread commits multi-table
+DML transactions while reader clients stream through the service, and
+the ``manifest.write``/``txn.commit``/``txn.between_tables`` points
+kill commits mid-flight. Its invariants extend the four above:
+
+- **no torn manifest ever observed** — no reader or recovery path sees
+  a half-written manifest/snapshot JSON (the atomic-rename contract);
+- **snapshot-consistent reads** — every completed response is
+  hash-identical to SOME published warehouse version replayed whole
+  (``AS OF`` reference hashing), never a cross-table blend of two.
 """
 from __future__ import annotations
 
@@ -65,7 +77,7 @@ class CampaignSpec:
     seed: int = 0xC0FFEE
     clients: int = 8
     queries_per_client: int = 8
-    #: fault points the plan arms (default: all six)
+    #: fault points the plan arms (default: every registered point)
     points: tuple = FAULT_POINTS
     #: firings cap per armed spec (``times``): bounds the blast radius
     #: and, with probability 1.0, makes fired counts deterministic
@@ -232,6 +244,7 @@ class ChaosCampaign:
                             and base[sql] != h:
                         state["mismatches"].append(label)
                     state["hashes"].setdefault(sql, h)
+                    state["all_hashes"].setdefault(sql, set()).add(h)
                 elif is_typed(err):
                     state["typed"][type(err).__name__] += 1
                 else:
@@ -245,7 +258,8 @@ class ChaosCampaign:
         total = sum(len(q) for q in workload.values())
         state = {"lock": threading.Lock(), "done": 0, "completed": 0,
                  "typed": Counter(), "untyped": [], "mismatches": [],
-                 "hashes": {}, "baseline_hashes": baseline_hashes,
+                 "hashes": {}, "all_hashes": {},
+                 "baseline_hashes": baseline_hashes,
                  "total": total}
         FLIGHT.record("lifecycle_phase", phase=f"chaos:{name}",
                       status="start", clients=self.spec.clients)
@@ -276,6 +290,12 @@ class ChaosCampaign:
                 "hash_mismatches": state["mismatches"][:10],
                 "hash_mismatch_count": len(state["mismatches"]),
                 "hashes": state["hashes"],
+                # EVERY distinct hash observed per text (a client under a
+                # moving warehouse legitimately sees several versions; the
+                # txn campaign checks each against the per-version
+                # reference set)
+                "all_hashes": {s: sorted(hs)
+                               for s, hs in state["all_hashes"].items()},
                 "metrics_delta": delta}
 
     def _arm_wave(self, wave: Wave) -> None:
@@ -378,6 +398,7 @@ class ChaosCampaign:
             if baseline["qps"] else None
         for phase in (baseline, armed, recovery):
             phase.pop("hashes")     # bulky; the comparison already ran
+            phase.pop("all_hashes")
         record = {
             "schema_version": 1,
             "spec": asdict(spec),
@@ -464,3 +485,247 @@ def demo_pool() -> list:
                  "SELECT k, COUNT(*) AS n, SUM(v) AS sv FROM sfact "
                  "GROUP BY k ORDER BY k"))
     return pool
+
+
+# -- the transactional campaign: chaos mid-DML over a live warehouse --------
+
+#: the commit-path points the txn campaign arms by default
+TXN_POINTS = ("manifest.write", "txn.commit", "txn.between_tables")
+
+
+def txn_pool() -> list:
+    """The warehouse demo's instantiation pool. Integer-only aggregates
+    (bit-identical across the service lane and direct replay — the
+    post-hoc verification hashes both) with the JOIN templates doing the
+    heavy lifting: a cross-table blend of two warehouse versions (fact@v2
+    joined to dim@v1) hashes unlike ANY single published version, so the
+    snapshot-consistency check catches exactly the torn-commit failure."""
+    tpl = ("SELECT grp, COUNT(*) AS n, SUM(qty) AS tq FROM wfact "
+           "JOIN wdim ON fk = dk WHERE qty BETWEEN {a} AND {b} "
+           "GROUP BY grp ORDER BY grp")
+    pool = [(f"txnjoin#{i}", tpl.format(a=1 + i, b=70 + 3 * i))
+            for i in range(5)]
+    pool.append(("txnfact#0",
+                 "SELECT COUNT(*) AS n, SUM(qty) AS tq FROM wfact"))
+    pool.append(("txndim#0", "SELECT COUNT(*) AS n FROM wdim"))
+    return pool
+
+
+def build_txn_demo(work_dir: str):
+    """A self-contained TRANSACTIONAL chaos target: a two-table warehouse
+    seeded through one transaction (the snapshot log is live from version
+    1), a WRITER session that owns the DML transactions, and a separate
+    READER session over its own Warehouse handle — the topology snapshot
+    isolation requires (the writer reads its own uncommitted writes; the
+    reader pins to the published CURRENT and only advances on refresh).
+
+    Returns ``(reader_session, writer_session, writer_warehouse, pool)``.
+    """
+    import os
+
+    import numpy as np
+    import pyarrow as pa
+
+    from .config import EngineConfig
+    from .engine import Session
+    from .warehouse import Warehouse
+
+    root = os.path.join(work_dir, "txn_wh")
+    writer_wh = Warehouse(root)
+    rng = np.random.default_rng(31)
+    n_fact, n_dim = 6000, 40
+    fact = pa.table({
+        "fk": pa.array(rng.integers(0, n_dim, n_fact), type=pa.int64()),
+        "qty": pa.array(rng.integers(1, 100, n_fact), type=pa.int64()),
+    })
+    dim = pa.table({"dk": pa.array(np.arange(n_dim), type=pa.int64()),
+                    "grp": pa.array((np.arange(n_dim) % 5)
+                                    .astype(np.int64))})
+    with writer_wh.transaction(committer="seed"):
+        writer_wh.table("wfact").create(fact, partition=False)
+        writer_wh.table("wdim").create(dim, partition=False)
+
+    writer = Session(EngineConfig())
+    writer.attach_warehouse(writer_wh)
+    # staging sources the DML rounds insert from: plain in-core tables
+    # (INSERT reads them; they are never versioned). The dim staging rows
+    # reuse EXISTING join keys with fresh groups, so a dim insert changes
+    # the join result — a fact@new/dim@old blend is hash-detectable.
+    stage_fact = pa.table({
+        "fk": pa.array(rng.integers(0, n_dim, 400), type=pa.int64()),
+        "qty": pa.array(rng.integers(1, 100, 400), type=pa.int64()),
+    })
+    stage_dim = pa.table({
+        "dk": pa.array(np.arange(30) % n_dim, type=pa.int64()),
+        "grp": pa.array(5 + (np.arange(30) % 3), type=pa.int64()),
+    })
+    writer.register_arrow("stage_fact", stage_fact)
+    writer.register_arrow("stage_dim", stage_dim)
+
+    reader = Session(EngineConfig())
+    reader.attach_warehouse(Warehouse(root))
+    return reader, writer, writer_wh, txn_pool()
+
+
+def run_txn_campaign(spec: CampaignSpec, work_dir: str,
+                     dml_rounds: int = 4) -> dict:
+    """Baseline -> armed -> recovery against a LIVE warehouse: during the
+    armed phase a writer thread commits two-table transactions (and
+    aborts them when the armed commit-path points fire) while the reader
+    clients stream through the service, refreshing their pinned snapshot
+    after every round.
+
+    The verdict is post-hoc and exhaustive: every published warehouse
+    version is replayed WHOLE through a fresh ``AS OF``-pinned session,
+    and every hash any client observed in any phase must equal one of
+    those per-version references (``snapshot_consistent_reads``) — a
+    response blending two versions, or reading an uncommitted write, has
+    no matching reference and fails the campaign. ``dml_rounds`` must
+    exceed the armed points' total firing budget so at least one
+    transaction lands (``dml_progress``); 0 auto-scales to
+    ``len(points) * times_per_point + 2``."""
+    from .config import EngineConfig
+    from .engine import Session
+    from .service import QueryService, ServiceConfig
+    from .warehouse import Warehouse
+
+    if dml_rounds <= 0:
+        dml_rounds = len(spec.points) * spec.times_per_point + 2
+    reader, writer, writer_wh, pool = build_txn_demo(work_dir)
+    root = writer_wh.root
+    campaign = ChaosCampaign(spec, pool)
+    prev_flight = (FLIGHT.enabled, FLIGHT.dump_dir, FLIGHT.trip_cooldown_s)
+    capacity = max(4096, 80 * spec.clients * spec.queries_per_client)
+    FLIGHT.configure(enabled=True, trip_cooldown_s=0.0,
+                     capacity=capacity, clear=True)
+    FLIGHT.dump_dir = spec.dump_dir
+    cfg = ServiceConfig(
+        max_pending=max(256, 4 * spec.clients),
+        breaker=CircuitBreakerConfig(
+            open_s=spec.breaker_open_s,
+            min_failures=spec.breaker_min_failures)
+        if spec.breaker else None,
+        retry_budget=spec.retry_budget,
+        ticket_attempts=spec.ticket_attempts,
+        dispatch_timeout_s=spec.dispatch_timeout_s)
+    dml = {"commits": 0, "aborts": 0, "errors": [], "refresh_errors": []}
+
+    def dml_driver(state):
+        """The writer thread body (runs beside the armed clients): each
+        round is one atomic two-table transaction. A fired fault aborts
+        the round — typed, rolled back, previous snapshot stays current —
+        and the next round retries fresh. Readers advance only here,
+        between rounds, via refresh (never mid-transaction)."""
+        for i in range(dml_rounds):
+            try:
+                with writer_wh.transaction(committer=f"dml{i}"):
+                    writer.execute(
+                        "INSERT INTO wfact SELECT fk, qty FROM stage_fact"
+                        f" WHERE qty <= {25 + 9 * i}")
+                    writer.execute(
+                        "INSERT INTO wdim SELECT dk, grp FROM stage_dim"
+                        f" WHERE dk <= {10 + 7 * i}")
+                dml["commits"] += 1
+                writer.refresh_warehouse()
+            except Exception as e:
+                if is_typed(e):
+                    dml["aborts"] += 1
+                else:
+                    dml["errors"].append(
+                        f"dml{i}: {type(e).__name__}: {e}")
+            try:
+                reader.refresh_warehouse()
+            except Exception as e:
+                dml["refresh_errors"].append(
+                    f"dml{i}: {type(e).__name__}: {e}")
+
+    try:
+        with QueryService(reader, cfg) as svc:
+            for _label, sql in pool:
+                svc.sql(sql, label="chaos_warm")
+                svc.sql(sql, label="chaos_warm")
+            baseline = campaign._run_phase(svc, "baseline")
+            for wave in campaign.plan:
+                if wave.at_fraction <= 0:
+                    campaign._arm_wave(wave)
+            # no baseline_hashes: under a moving warehouse the armed
+            # phase's reference is the per-version replay below, not the
+            # v1-only baseline
+            armed = campaign._run_phase(svc, "armed", driver=dml_driver)
+            fired = campaign.disarm()
+            recovery = campaign._run_phase(svc, "recovery")
+    finally:
+        campaign.disarm()
+        (FLIGHT.enabled, FLIGHT.dump_dir,
+         FLIGHT.trip_cooldown_s) = prev_flight
+
+    # -- post-hoc verdict ---------------------------------------------------
+    # reopening runs recovery (the writer thread has exited; any dirty
+    # abort's intent record is swept now) and then replays every published
+    # version whole for the reference hash set
+    verify_wh = Warehouse(root)
+    versions = verify_wh.versions()
+    allowed: dict[str, set] = {sql: set() for _l, sql in pool}
+    for v in versions:
+        s = Session(EngineConfig())
+        s.attach_warehouse(Warehouse(root), at_version=v)
+        for _label, sql in pool:
+            allowed[sql].add(result_hash(s.sql(sql)))
+    observed: dict[str, set] = {}
+    for phase in (baseline, armed, recovery):
+        for sql, hs in phase["all_hashes"].items():
+            observed.setdefault(sql, set()).update(hs)
+    stray = {sql: sorted(hs - allowed.get(sql, set()))
+             for sql, hs in observed.items()
+             if hs - allowed.get(sql, set())}
+
+    corrupt_markers = ("corrupt warehouse manifest", "JSONDecodeError",
+                       "Expecting value")
+
+    def _torn(msgs):
+        return [m for m in msgs
+                if any(k in m for k in corrupt_markers)]
+
+    torn = (_torn(dml["errors"]) + _torn(dml["refresh_errors"])
+            + _torn(baseline["untyped_failures"])
+            + _torn(armed["untyped_failures"])
+            + _torn(recovery["untyped_failures"]))
+
+    for phase in (baseline, armed, recovery):
+        phase.pop("hashes")
+        phase.pop("all_hashes")
+    record = {
+        "schema_version": 1,
+        "mode": "txn",
+        "spec": asdict(spec),
+        "plan": [{"at_fraction": w.at_fraction, "specs": w.specs}
+                 for w in campaign.plan],
+        "fired": fired,
+        "phases": {"baseline": baseline, "armed": armed,
+                   "recovery": recovery},
+        "dml": {"rounds": dml_rounds, "commits": dml["commits"],
+                "aborts": dml["aborts"], "errors": dml["errors"][:10],
+                "refresh_errors": dml["refresh_errors"][:10]},
+        "warehouse_versions": versions,
+        "current_version": verify_wh.current_version(),
+        "txn_metrics": {
+            k: armed["metrics_delta"].get(k, 0)
+            for k in ("txn_commits", "txn_rollbacks", "txn_recoveries")},
+        "stray_hashes": {sql: hs[:4] for sql, hs in stray.items()},
+        "invariants": {
+            "all_failures_typed":
+                baseline["untyped_count"] == 0
+                and armed["untyped_count"] == 0
+                and recovery["untyped_count"] == 0
+                and not dml["errors"],
+            # every completed response equals SOME published version
+            # replayed whole — never a cross-table blend of two
+            "snapshot_consistent_reads": not stray,
+            # no reader, refresh, or DML path ever parsed a half-written
+            # manifest or snapshot record
+            "no_torn_manifest_reads":
+                not torn and not dml["refresh_errors"],
+            "dml_progress": dml["commits"] >= 1,
+        },
+    }
+    return record

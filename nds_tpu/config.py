@@ -183,6 +183,16 @@ class EngineConfig:
     # from the warehouse; here eviction forces a re-upload on next use).
     # 0 disables eviction.
     scan_budget_gb: float = 10.0
+    # -- transactional warehouse (warehouse.py _snapshots log) -------------
+    # wrap each LF_*/DF_* maintenance function in ONE atomic multi-table
+    # warehouse transaction (write-ahead intent record, fsync-atomic
+    # CURRENT publication, crash recovery at next open) and PIN reader
+    # registrations to the latest published warehouse version, so a
+    # statement never sees table A at version k beside table B at k+1.
+    # False = the pre-transactional per-table commit path, bit-identical
+    # behavior, no _snapshots log ever created, and all three txn_*
+    # counters stay zero. Property: nds.tpu.warehouse_transactions.
+    warehouse_transactions: bool = True
     # -- semantic result cache (engine/result_cache.py) --------------------
     # cross-client result reuse keyed by parameterized-plan fingerprint +
     # parameter vector: a repeat dashboard load is answered from the cache

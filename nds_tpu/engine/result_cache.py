@@ -580,11 +580,11 @@ def plan_for_cache(session, sql: str, backend: Optional[str] = None):
 class _Entry:
     __slots__ = ("key", "template_key", "family", "pvalues", "backend",
                  "result", "out_names", "out_dtypes", "tables", "gens",
-                 "stored_at", "plan", "ivm", "hits")
+                 "snaps", "stored_at", "plan", "ivm", "hits")
 
     def __init__(self, key, template_key, family, pvalues, backend,
-                 result, out_names, out_dtypes, tables, gens, stored_at,
-                 plan, ivm):
+                 result, out_names, out_dtypes, tables, gens, snaps,
+                 stored_at, plan, ivm):
         self.hits = 0          # lookups served (system.result_cache)
         self.key = key
         self.template_key = template_key
@@ -596,6 +596,11 @@ class _Entry:
         self.out_dtypes = out_dtypes
         self.tables = tables
         self.gens = gens
+        # per-table warehouse MANIFEST versions at store time (snapshot-
+        # pinned registrations only; {} when unpinned): the provable
+        # snapshot identity — a reader pinned to a different warehouse
+        # version never gets this entry, even within one session
+        self.snaps = snaps
         self.stored_at = stored_at
         self.plan = plan
         self.ivm = ivm
@@ -650,7 +655,13 @@ class ResultCache:
         if ttl > 0 and time.time() - entry.stored_at > ttl:
             return False
         gen = self.session.table_generation
-        return all(gen(t) == g for t, g in entry.gens.items())
+        if not all(gen(t) == g for t, g in entry.gens.items()):
+            return False
+        # snapshot-stamped entries additionally require the READER's
+        # pinned warehouse versions to match the entry's: the cached
+        # result is served only to the exact snapshot it came from
+        snap = self.session.table_snapshot_version
+        return all(snap(t) == s for t, s in entry.snaps.items())
 
     def _drop_locked(self, key, reason: str) -> None:
         entry = self._entries.pop(key, None)
@@ -761,7 +772,7 @@ class ResultCache:
             derived = _Entry(key, tk, info.family_key, pv, tag, table,
                              list(cand.out_names), list(cand.out_dtypes),
                              cand.tables, dict(cand.gens),
-                             cand.stored_at, None, None)
+                             dict(cand.snaps), cand.stored_at, None, None)
             self._insert_entry(sql, derived)
             return CacheHit(table, "subsumed")
         return None
@@ -806,6 +817,15 @@ class ResultCache:
         key = (tk, pv, tag)
         if gens is None:
             gens = {t: session.table_generation(t) for t in tables}
+        # any registration between dispatch and store moved the gens (a
+        # snapshot change always re-registers), so capturing snaps here
+        # is race-free: a mismatch coincides with a gens mismatch that
+        # already invalidates the entry
+        snaps = {}
+        for t in tables:
+            sv = session.table_snapshot_version(t)
+            if sv is not None:
+                snaps[t] = sv
         ivm = None
         family = None
         info = self._template_info(tk, plan)
@@ -815,7 +835,7 @@ class ResultCache:
             family = info.family_key
         entry = _Entry(key, tk, family, pv, tag, result,
                        list(plan.out_names), list(plan.out_dtypes),
-                       tables, gens, time.time(), plan, ivm)
+                       tables, gens, snaps, time.time(), plan, ivm)
         self._insert_entry(sql, entry)
         FLIGHT.record("cache_store", template=str(tk)[:12],
                       tables=",".join(tables), ivm=ivm is not None)
@@ -962,10 +982,15 @@ class ResultCache:
                             st.p_names, st.p_dtypes, partial,
                             st.partial_plan, st.scan_by_table)
         gens = {t: gen(t) for t in entry.gens}
+        snaps = {}
+        for t in entry.tables:
+            sv = session.table_snapshot_version(t)
+            if sv is not None:
+                snaps[t] = sv
         return _Entry(entry.key, entry.template_key, entry.family,
                       entry.pvalues, entry.backend, result,
                       entry.out_names, entry.out_dtypes, entry.tables,
-                      gens, time.time(), entry.plan, new_ivm)
+                      gens, snaps, time.time(), entry.plan, new_ivm)
 
     def _delta_table(self, scan, arrow_rows):
         """Arrow delta rows -> engine Table in the scan's projection; the

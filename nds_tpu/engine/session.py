@@ -160,6 +160,15 @@ class Session:
         # evicts cached results over table B (the global counter stays the
         # stream-cache/compiled-plan key — those embed cross-table state)
         self._table_generations: dict[str, int] = {}
+        # snapshot-pinned warehouse reads (warehouse.py _snapshots log):
+        # per-table MANIFEST versions of the pinned warehouse version
+        # (Warehouse.register_all fills both; empty/None when unpinned —
+        # no snapshot log, warehouse_transactions off, or the writer
+        # session mid-transaction). The result cache stamps entries with
+        # these, so a cached result is provably from the snapshot the
+        # reader pinned, not merely "same session generation".
+        self._table_snapshot_versions: dict[str, int] = {}
+        self._warehouse_version: Optional[int] = None
         # source-content fingerprints for warehouse registrations: lets
         # Warehouse.register_all skip tables whose snapshot files did not
         # change (a maintenance INSERT into store_sales must not bump the
@@ -281,6 +290,17 @@ class Session:
     def table_generation(self, name: str) -> int:
         """Current per-table catalog generation (0 = never registered)."""
         return self._table_generations.get(name, 0)
+
+    def table_snapshot_version(self, name: str) -> Optional[int]:
+        """Manifest version of `name` under the pinned warehouse
+        snapshot, or None when the table's registration is unpinned
+        (non-warehouse source, no snapshot log, or mid-transaction)."""
+        return self._table_snapshot_versions.get(name)
+
+    def warehouse_version(self) -> Optional[int]:
+        """The warehouse version this session's registrations are
+        pinned to (None = unpinned/manifest-latest)."""
+        return self._warehouse_version
 
     def attach_result_cache(self, cache) -> None:
         """Bind a semantic ResultCache (engine/result_cache.py): the cache
@@ -446,6 +466,7 @@ class Session:
         self._est_rows.pop(name, None)
         self._unique_cols.pop(name, None)
         self._source_files.pop(name, None)
+        self._table_snapshot_versions.pop(name, None)
         self._bump_generation(name)
 
     def table_names(self) -> list[str]:
@@ -1613,12 +1634,27 @@ class Session:
         return arrow_bridge.to_arrow(self.sql(query))
 
     # -- statements (DML/DDL for the maintenance test) -----------------------
-    def attach_warehouse(self, warehouse) -> None:
+    def attach_warehouse(self, warehouse,
+                         at_version: Optional[int] = None) -> None:
         """Bind a Warehouse so INSERT/DELETE statements commit snapshots
         (the reference runs these against Iceberg/Delta catalogs,
-        nds_maintenance.py:107-116)."""
+        nds_maintenance.py:107-116). With a published snapshot log the
+        registrations pin to ONE warehouse version; ``at_version`` time-
+        travels the whole warehouse to an older published version
+        (``AS OF``-style reads — the rollback machinery generalized to
+        warehouse level, read-only: no new snapshot is committed)."""
         self.warehouse = warehouse
-        warehouse.register_all(self)
+        warehouse.register_all(self, at_version=at_version)
+
+    def refresh_warehouse(self) -> None:
+        """Advance a snapshot-pinned reader to the latest PUBLISHED
+        warehouse version. Serialized on the statement lock, so an
+        in-flight statement finishes against the snapshot it pinned and
+        the next statement resolves against the new one."""
+        if self.warehouse is None:
+            return
+        with self._sql_lock:
+            self.warehouse.register_all(self)
 
     def execute(self, sql_text: str, backend: Optional[str] = None):
         """Execute one or more ';'-separated statements; returns the last

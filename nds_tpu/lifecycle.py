@@ -80,8 +80,11 @@ class LifecycleConfig:
     #: an armed fault campaign during both throughput rounds
     chaos: bool = False
     chaos_seed: int = 0xC0FFEE
+    #: the commit-path points (manifest.write / txn.*) kill maintenance
+    #: transactions mid-commit — recovery at the next warehouse open is
+    #: what makes the phase re-enterable
     chaos_points: tuple = ("device.put", "jax.compile", "jax.execute",
-                           "query.run")
+                           "query.run", "txn.between_tables")
     chaos_times_per_point: int = 2
 
     def __post_init__(self):
@@ -195,11 +198,25 @@ class LifecycleRunner:
                             f"maintenance_{stream}.csv")
 
     def _run_maintenance_round(self, ids: list) -> None:
+        """Crash-RESUMABLE: each refresh function commits one atomic
+        warehouse transaction, so a kill mid-round leaves the previous
+        published snapshot current and re-entry (the phase-attempts
+        loop, or a whole fresh lifecycle run resuming from checkpoints)
+        starts by discarding the orphaned partial commit at warehouse
+        open — ``txn_recoveries`` below counts exactly those sweeps."""
+        from .obs.metrics import METRICS
+
+        before = METRICS.snapshot()
         for s in ids:
             maintenance.run_maintenance(
                 self.cfg.warehouse_path,
                 _refresh_dir(self.cfg.data_path, s), self._dm_log(s),
                 backend=self.cfg.backend, decimal=self.cfg.decimal)
+        delta = METRICS.delta(before)
+        self.state.setdefault("txn", {})
+        for k in ("txn_commits", "txn_rollbacks", "txn_recoveries"):
+            self.state["txn"][k] = (self.state["txn"].get(k, 0)
+                                    + delta.get(k, 0))
 
     def _phase_throughput(self, rnd: int) -> None:
         cfg = self.cfg

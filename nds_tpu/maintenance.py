@@ -119,11 +119,27 @@ def run_maintenance(warehouse_path: str, refresh_dir: str, time_log: str,
         start = int(time.time() * 1000)
         from .obs.metrics import METRICS
         before = METRICS.snapshot()
+        use_txn = getattr(config, "warehouse_transactions", True)
 
-        def run_all(variants=variants):
-            for v in variants:
-                session.execute(v, backend=backend)
+        def run_all(variants=variants, func=func):
+            # one atomic warehouse transaction per refresh function: a
+            # kill between its table writes (DF_SS touches store_sales
+            # AND store_returns) leaves the previous published snapshot
+            # current, and the orphaned partial commit is discarded by
+            # recovery at next open — the phase is crash-RESUMABLE, not
+            # re-runnable-and-hope
+            if use_txn:
+                with wh.transaction(committer=func):
+                    for v in variants:
+                        session.execute(v, backend=backend)
+            else:
+                for v in variants:
+                    session.execute(v, backend=backend)
         report.report_on(run_all)
+        if use_txn:
+            # re-pin the writer session to the version it just published
+            # (mid-transaction registrations are deliberately unpinned)
+            session.refresh_warehouse()
         elapsed = report.summary["queryTimes"][-1]
         status = report.summary["queryStatus"][-1]
         rows.append((func, start, start + elapsed, elapsed))

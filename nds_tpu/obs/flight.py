@@ -30,7 +30,13 @@ marks a breaker/watchdog/fault-storm moment (reason field), ``probe`` a
 half-open breaker admission or its closing outcome, ``quarantine`` a
 shared compiled program evicted after repeated strikes, and
 ``lifecycle_phase``/``maintenance`` the scored-lifecycle runner's phase
-transitions interleaving with live service traffic.
+transitions interleaving with live service traffic. The transactional
+vocabulary (``nds_tpu/warehouse``): ``txn_commit`` an atomic cross-table
+warehouse commit landing (committer, published version, tables touched),
+``txn_rollback`` a transaction aborting back to its base snapshot
+(``clean`` records whether the intent record was retired or left for
+recovery), and ``txn_recover`` a reopened warehouse discarding a dead
+writer's orphaned partial commit.
 
 Disabled (the default outside the service) a record() is one attribute
 read — the same near-zero contract as the span tracer. Enable with

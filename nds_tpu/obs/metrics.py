@@ -712,6 +712,23 @@ QUERY_LOG_ROWS = METRICS.counter(
 QUERY_LOG_ROTATIONS = METRICS.counter(
     "query_log_rotations", "query-log JSONL files rolled by the "
     "size-capped rotation (oldest rotated file deleted past max_files)")
+# Transactional warehouse (warehouse.py _snapshots log): atomic multi-
+# table commits, aborts, and crash recovery — all exactly zero on a
+# query-only workload (the metrics gate pins all three strict-zero on
+# its clean, maintenance-free workload) and zero whenever
+# EngineConfig.warehouse_transactions is off
+TXN_COMMITS = METRICS.counter(
+    "txn_commits", "warehouse transactions published atomically (one "
+    "version record + CURRENT swing naming every table's manifest "
+    "version — the cross-table commit point)")
+TXN_ROLLBACKS = METRICS.counter(
+    "txn_rollbacks", "warehouse transactions aborted (per-table "
+    "manifests truncated back to the transaction's base versions) plus "
+    "explicit rollback_to_version restores")
+TXN_RECOVERIES = METRICS.counter(
+    "txn_recoveries", "orphaned in-progress transactions discarded at "
+    "warehouse open (crash recovery: each table back to max(base, "
+    "published) — never a blend of pre- and post-commit state)")
 
 # Service latency distributions (histogram families): the base series
 # aggregates every query; the service also records per-(tenant, template)

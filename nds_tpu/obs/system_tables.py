@@ -82,6 +82,10 @@ SYSTEM_SCHEMAS: dict[str, tuple[tuple, tuple]] = {
     "system.tables": (
         ("name", "generation", "est_rows", "columns", "unique_cols"),
         ("str", "int", "int", "int", "str")),
+    "system.snapshots": (
+        ("version", "timestamp_ms", "committer", "tables",
+         "table_count", "current", "pinned"),
+        ("int", "int", "str", "str", "int", "bool", "bool")),
 }
 
 
@@ -197,6 +201,28 @@ def _tables_rows(session) -> list[dict]:
                 for n in names]
 
 
+def _snapshot_rows(session) -> list[dict]:
+    """The attached warehouse's published version log: one row per
+    atomic cross-table commit (``tables`` is the ``name@manifest-
+    version`` map the version pins; ``current`` marks the published
+    head, ``pinned`` the version this session's reads resolve against)."""
+    wh = getattr(session, "warehouse", None) if session is not None \
+        else None
+    if wh is None:
+        return []
+    cur = wh.current_version()
+    pinned = session.warehouse_version()
+    return [{"version": rec["version"],
+             "timestamp_ms": rec["timestamp_ms"],
+             "committer": rec.get("committer") or None,
+             "tables": ",".join(
+                 f"{t}@{v}" for t, v in sorted(rec["tables"].items())),
+             "table_count": len(rec["tables"]),
+             "current": rec["version"] == cur,
+             "pinned": rec["version"] == pinned}
+            for rec in wh.snapshot_records()]
+
+
 PROVIDERS: dict[str, Callable] = {
     "system.query_log": _query_log_rows,
     "system.metrics": _metrics_rows,
@@ -206,6 +232,7 @@ PROVIDERS: dict[str, Callable] = {
     "system.device_memory": _device_memory_rows,
     "system.flight": _flight_rows,
     "system.tables": _tables_rows,
+    "system.snapshots": _snapshot_rows,
 }
 
 

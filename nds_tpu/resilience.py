@@ -469,8 +469,16 @@ class CircuitBreaker:
 #:   jax.execute  - execution of a device program (compiled run / eager record)
 #:   stream.spawn - throughput supervisor starting a stream attempt
 #:   query.run    - power runner starting a timed query (detail = query name)
+#:   manifest.write     - warehouse manifest publication, BEFORE any byte
+#:                        lands (warehouse.WarehouseTable._store_doc)
+#:   txn.commit         - warehouse transaction about to publish its
+#:                        version record + CURRENT (the commit point)
+#:   txn.between_tables - a SECOND distinct table joining an open
+#:                        warehouse transaction (the mid-commit kill
+#:                        window: table A committed, table B untouched)
 FAULT_POINTS = ("arrow.read", "device.put", "jax.compile", "jax.execute",
-                "stream.spawn", "query.run")
+                "stream.spawn", "query.run",
+                "manifest.write", "txn.commit", "txn.between_tables")
 
 #: default sleep for a ``hang`` spec with no explicit duration: long enough
 #: that only a deadline/supervisor kill ends the attempt.

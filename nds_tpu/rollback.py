@@ -4,6 +4,16 @@ Capability parity with the reference rollback tool (reference
 nds/nds_rollback.py:36-55: Iceberg ``rollback_to_timestamp`` over the fact
 tables the maintenance test modifies, so Throughput/Maintenance test pairs
 can re-run against identical data).
+
+Two modes:
+
+- **per-table timestamp** (reference parity, the original mode): each
+  fact table independently restores its latest snapshot at or before
+  the given timestamp;
+- **warehouse version** (``--version`` / ``--list``, over the
+  ``_snapshots`` log): every table restores to its manifest version
+  under ONE published warehouse version, committed atomically — the
+  whole warehouse lands on a single consistent cut, never a blend.
 """
 from __future__ import annotations
 
@@ -30,12 +40,49 @@ def rollback(warehouse_path: str, timestamp_ms: int,
                   f"{timestamp_ms} (new version {snap['version']})")
 
 
+def rollback_version(warehouse_path: str, version: int) -> None:
+    """Atomic warehouse-level rollback to a published version."""
+    wh = Warehouse(warehouse_path)
+    new = wh.rollback_to_version(version)
+    print(f"warehouse: rolled back to version {version} "
+          f"(published as version {new})")
+
+
+def list_versions(warehouse_path: str) -> None:
+    wh = Warehouse(warehouse_path)
+    records = wh.snapshot_records()
+    if not records:
+        print("no warehouse snapshot log (no transaction committed yet)")
+        return
+    cur = wh.current_version()
+    for rec in records:
+        mark = "*" if rec["version"] == cur else " "
+        tables = ",".join(f"{t}@{v}"
+                          for t, v in sorted(rec["tables"].items()))
+        print(f"{mark} v{rec['version']} ts={rec['timestamp_ms']} "
+              f"committer={rec.get('committer') or '-'} {tables}")
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(prog="nds_tpu.rollback")
     p.add_argument("warehouse_path")
-    p.add_argument("timestamp_ms", type=int)
+    p.add_argument("timestamp_ms", type=int, nargs="?", default=None,
+                   help="per-table timestamp rollback (reference parity)")
     p.add_argument("--tables", default=None)
+    p.add_argument("--version", type=int, default=None,
+                   help="atomic warehouse-level rollback to a published "
+                        "snapshot-log version")
+    p.add_argument("--list", action="store_true",
+                   help="list published warehouse versions and exit")
     a = p.parse_args(argv)
+    if a.list:
+        list_versions(a.warehouse_path)
+        return 0
+    if a.version is not None:
+        rollback_version(a.warehouse_path, a.version)
+        return 0
+    if a.timestamp_ms is None:
+        p.error("timestamp_ms required (or use --version / --list)")
     rollback(a.warehouse_path, a.timestamp_ms,
              a.tables.split(",") if a.tables else None)
     return 0

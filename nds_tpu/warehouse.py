@@ -15,6 +15,29 @@ Layout per table:
 A snapshot is {"version", "timestamp_ms", "files": [...]} — files are
 relative paths. Writers never mutate data files; insert appends files,
 delete rewrites affected files into new ones. Readers pin a snapshot.
+
+Warehouse-level transactions (``<root>/_snapshots/``): per-table
+manifests give each table its own history, but a query racing
+maintenance could still see table A at generation k and table B at
+k+1. The snapshot log makes cross-table commits atomic:
+
+    <root>/_snapshots/v<N>.json              (version record: every
+                                              table's manifest version)
+    <root>/_snapshots/CURRENT                (the published version —
+                                              THE commit point)
+    <root>/_snapshots/txn-<id>.inprogress.json  (write-ahead intent:
+                                              the base versions an open
+                                              transaction started from)
+
+``Warehouse.transaction()`` writes the intent record, lets any number
+of per-table commits land, then publishes one version record and swings
+``CURRENT`` — all via fsync + atomic rename, so a kill at ANY byte
+leaves either the previous or the next snapshot current, never a
+blend. Recovery at open truncates per-table manifests back past any
+orphaned in-progress transaction (``max(base, published)`` per table —
+committed work and non-transactional commits are never touched).
+``register_all`` pins reader sessions to the published version, so a
+statement resolves every table against ONE warehouse snapshot.
 """
 from __future__ import annotations
 
@@ -27,6 +50,8 @@ import uuid
 import pyarrow as pa
 import pyarrow.parquet as pq
 
+from .resilience import FAULTS
+
 # fact-table partition keys (reference nds_transcode.py:45-53)
 TABLE_PARTITIONING = {
     "catalog_sales": "cs_sold_date_sk",
@@ -37,6 +62,36 @@ TABLE_PARTITIONING = {
     "web_sales": "ws_sold_date_sk",
     "web_returns": "wr_returned_date_sk",
 }
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync a directory so a just-renamed entry survives power loss
+    (best-effort: some filesystems refuse directory fds)."""
+    try:
+        fd = os.open(path or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _atomic_write_json(path: str, doc: dict) -> None:
+    """Crash-consistent JSON publication: unique temp file + flush +
+    fsync(file) + atomic rename + fsync(dir). A reader opening ``path``
+    sees either the previous complete document or this one — never a
+    prefix; a crash at any byte leaves at worst an orphaned ``*.tmp``
+    no reader ever opens."""
+    tmp = f"{path}.{uuid.uuid4().hex[:8]}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(path))
 
 
 def _read_file(path: str) -> pa.Table:
@@ -126,37 +181,29 @@ def _enc_file_stats(table: pa.Table) -> dict:
 
 
 class WarehouseTable:
-    def __init__(self, root: str, name: str):
+    def __init__(self, root: str, name: str, warehouse=None):
         self.dir = os.path.join(root, name)
         self.name = name
         self.manifest_path = os.path.join(self.dir, "manifest.json")
+        #: owning Warehouse (set by Warehouse.table): commits notify its
+        #: open transaction; a bare WarehouseTable commits untracked
+        self._warehouse = warehouse
 
     # -- manifest ------------------------------------------------------------
     def _load_doc(self) -> dict:
         if not os.path.exists(self.manifest_path):
             return {"table": self.name, "snapshots": [], "file_stats": {},
                     "enc_stats": {}}
-        # manifests are replaced atomically (tmp + os.replace in
-        # _store_doc), so a reader should always see a complete doc — but
-        # chaos rounds run maintenance DML concurrently with service
-        # registrations on filesystems whose rename-vs-open atomicity is
-        # weaker than POSIX promises (overlayfs CI hosts), so a decode
-        # failure gets a bounded re-read before it becomes a hard error
-        # naming the file (not a bare JSONDecodeError three layers up)
-        last: Exception | None = None
-        for attempt in range(3):
-            if attempt:
-                time.sleep(0.05 * attempt)
-            try:
-                with open(self.manifest_path) as f:
-                    doc = json.load(f)
-                break
-            except json.JSONDecodeError as e:
-                last = e
-        else:
+        # manifests are published fsync-atomically (_store_doc), so a
+        # torn read is impossible by construction — a decode failure is
+        # real corruption and fails loudly, naming the file
+        try:
+            with open(self.manifest_path) as f:
+                doc = json.load(f)
+        except json.JSONDecodeError as e:
             raise RuntimeError(
-                f"corrupt warehouse manifest {self.manifest_path} "
-                f"(persisted across re-reads): {last}") from last
+                f"corrupt warehouse manifest {self.manifest_path}: "
+                f"{e}") from e
         doc.setdefault("file_stats", {})
         doc.setdefault("enc_stats", {})
         return doc
@@ -165,10 +212,8 @@ class WarehouseTable:
         return self._load_doc()["snapshots"]
 
     def _store_doc(self, doc: dict) -> None:
-        tmp = self.manifest_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(doc, f, indent=1)
-        os.replace(tmp, self.manifest_path)
+        FAULTS.fire("manifest.write", self.name)
+        _atomic_write_json(self.manifest_path, doc)
 
     def _store(self, snapshots: list[dict]) -> None:
         doc = self._load_doc()
@@ -176,6 +221,13 @@ class WarehouseTable:
         self._store_doc(doc)
 
     def _commit(self, files: list[str]) -> dict:
+        # an open warehouse transaction hears about the commit BEFORE any
+        # byte lands (txn.between_tables fires here on the second
+        # distinct table — a kill leaves table A committed-but-
+        # unpublished and this table untouched; rollback/recovery
+        # truncates A back to its base)
+        if self._warehouse is not None:
+            self._warehouse._txn_touch(self.name)
         doc = self._load_doc()
         snapshots = doc["snapshots"]
         snap = {"version": len(snapshots) + 1,
@@ -190,6 +242,22 @@ class WarehouseTable:
         self._new_enc_stats = {}
         self._store_doc(doc)
         return snap
+
+    def manifest_version(self) -> int:
+        """Number of committed snapshots (the table's manifest version;
+        0 = no snapshot yet)."""
+        return len(self._load())
+
+    def files_at_version(self, version: int) -> list[str]:
+        """Absolute data-file paths of manifest snapshot ``version``
+        (1-based; snapshot versions are sequential by construction)."""
+        snaps = self._load()
+        if not 1 <= version <= len(snaps):
+            raise ValueError(
+                f"table {self.name} has no manifest version {version} "
+                f"(have 1..{len(snaps)})")
+        return [os.path.join(self.dir, f)
+                for f in snaps[version - 1]["files"]]
 
     def file_stats(self) -> dict:
         """{relative file path: {column: [min, max]}} for files written
@@ -458,28 +526,315 @@ class WarehouseTable:
                                 promote_options="permissive")
 
 
+class WarehouseTransaction:
+    """One atomic multi-table commit over a Warehouse (single writer).
+
+    ``__enter__`` writes the fsync-atomic intent record
+    (``txn-<id>.inprogress.json``) naming every table's base manifest
+    version; per-table commits inside the body append manifests as
+    usual; ``__exit__`` publishes ONE version record and swings
+    ``CURRENT`` (the commit point), or — on any exception, including a
+    fired ``txn.commit``/``txn.between_tables`` fault — truncates every
+    touched manifest back to its base, so the previous snapshot stays
+    current. A kill at any point is repaired by recovery at next open.
+    """
+
+    def __init__(self, warehouse: "Warehouse", committer: str = ""):
+        self.wh = warehouse
+        self.committer = committer
+        self.txn_id = uuid.uuid4().hex[:12]
+        self.base: dict[str, int] = {}
+        self.touched: set[str] = set()
+        self._path = os.path.join(warehouse.snapshots_dir,
+                                  f"txn-{self.txn_id}.inprogress.json")
+
+    def __enter__(self) -> "WarehouseTransaction":
+        if self.wh._txn is not None:
+            raise RuntimeError("warehouse transaction already open")
+        os.makedirs(self.wh.snapshots_dir, exist_ok=True)
+        self.base = {n: self.wh.table(n).manifest_version()
+                     for n in self.wh.table_names()}
+        _atomic_write_json(self._path, {
+            "txn": self.txn_id, "committer": self.committer,
+            "pid": os.getpid(),
+            "started_ms": int(time.time() * 1000), "base": self.base})
+        self.wh._txn = self
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc_type is not None:
+            self._rollback()
+            return False
+        try:
+            self._commit()
+        except BaseException:
+            self._rollback()
+            raise
+        return False
+
+    def _commit(self) -> None:
+        from .obs.flight import FLIGHT
+        from .obs.metrics import TXN_COMMITS
+
+        FAULTS.fire("txn.commit", self.committer or self.txn_id)
+        version = self.wh.current_version() + 1
+        tables = {n: self.wh.table(n).manifest_version()
+                  for n in self.wh.table_names()}
+        tables = {n: v for n, v in tables.items() if v > 0}
+        _atomic_write_json(
+            os.path.join(self.wh.snapshots_dir, f"v{version}.json"),
+            {"version": version, "timestamp_ms": int(time.time() * 1000),
+             "committer": self.committer, "tables": tables})
+        # THE commit point: everything before this rename rolls back on
+        # recovery, everything after survives
+        _atomic_write_json(self.wh.current_path, {"version": version})
+        try:
+            os.unlink(self._path)
+        except FileNotFoundError:
+            pass
+        self.wh._txn = None
+        TXN_COMMITS.inc()
+        FLIGHT.record("txn_commit", committer=self.committer,
+                      version=version, tables=len(self.touched))
+
+    def _rollback(self) -> None:
+        from .obs.flight import FLIGHT
+        from .obs.metrics import TXN_ROLLBACKS
+
+        clean = True
+        for name in sorted(set(self.base) | set(self.wh.table_names())):
+            wt = self.wh.table(name)
+            if not wt.exists():
+                continue
+            try:
+                doc = wt._load_doc()
+                target = self.base.get(name, 0)
+                if len(doc["snapshots"]) > target:
+                    doc["snapshots"] = doc["snapshots"][:target]
+                    wt._store_doc(doc)
+            except BaseException:
+                # a fault firing mid-rollback (manifest.write armed):
+                # keep the intent record — recovery at next open
+                # finishes the truncation from the same base map
+                clean = False
+        if clean:
+            try:
+                os.unlink(self._path)
+            except FileNotFoundError:
+                pass
+        self.wh._txn = None
+        TXN_ROLLBACKS.inc()
+        FLIGHT.record("txn_rollback", committer=self.committer,
+                      tables=len(self.touched), clean=clean)
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except OSError:
+        return False
+    return True
+
+
 class Warehouse:
     def __init__(self, root: str):
         self.root = root
         os.makedirs(root, exist_ok=True)
+        self.snapshots_dir = os.path.join(root, "_snapshots")
+        self.current_path = os.path.join(self.snapshots_dir, "CURRENT")
+        #: the open WarehouseTransaction (single writer per Warehouse)
+        self._txn: WarehouseTransaction | None = None
+        # warehouses that never opened a transaction have no _snapshots
+        # directory and skip recovery entirely (bit-identical legacy path)
+        if os.path.isdir(self.snapshots_dir):
+            self._recover()
 
     def table(self, name: str) -> WarehouseTable:
-        return WarehouseTable(self.root, name)
+        return WarehouseTable(self.root, name, warehouse=self)
 
     def table_names(self) -> list[str]:
         return sorted(
             os.path.basename(os.path.dirname(m)) for m in
             glob.glob(os.path.join(self.root, "*", "manifest.json")))
 
-    def register_all(self, session, est_rows: dict[str, int] | None = None):
-        """Register every warehouse table on an engine Session."""
+    # -- warehouse-level snapshot log ---------------------------------------
+    def transaction(self, committer: str = "") -> WarehouseTransaction:
+        """Open one atomic cross-table commit (context manager)."""
+        return WarehouseTransaction(self, committer)
+
+    def _txn_touch(self, name: str) -> None:
+        """A per-table commit is about to land: record it on the open
+        transaction and fire ``txn.between_tables`` when a SECOND
+        distinct table joins (the mid-commit kill window campaigns
+        target). No-op without an open transaction."""
+        txn = self._txn
+        if txn is None:
+            return
+        if name not in txn.touched:
+            if txn.touched:
+                FAULTS.fire("txn.between_tables", name)
+            txn.touched.add(name)
+
+    def current_version(self) -> int:
+        """The published warehouse version (0 = no snapshot log)."""
+        try:
+            with open(self.current_path) as f:
+                return int(json.load(f)["version"])
+        except (FileNotFoundError, json.JSONDecodeError, KeyError,
+                ValueError):
+            return 0
+
+    def versions(self) -> list[int]:
+        """Published warehouse versions, ascending (orphans excluded)."""
+        cur = self.current_version()
+        out = []
+        for p in glob.glob(os.path.join(self.snapshots_dir, "v*.json")):
+            try:
+                v = int(os.path.basename(p)[1:-5])
+            except ValueError:
+                continue
+            if 1 <= v <= cur:
+                out.append(v)
+        return sorted(out)
+
+    def version_record(self, version: int) -> dict:
+        """One version record: {"version", "timestamp_ms", "committer",
+        "tables": {name: manifest version}}."""
+        path = os.path.join(self.snapshots_dir, f"v{version}.json")
+        with open(path) as f:
+            return json.load(f)
+
+    def snapshot_records(self) -> list[dict]:
+        """Every published version record, ascending (system.snapshots
+        and the rollback CLI's --list view)."""
+        return [self.version_record(v) for v in self.versions()]
+
+    def rollback_to_version(self, version: int,
+                            committer: str = "") -> int:
+        """Restore every table to its state at warehouse ``version`` via
+        one new atomic commit (Iceberg-style: history only grows — the
+        restored state becomes the NEXT published version). Tables
+        created after ``version`` restore to empty."""
+        from .obs.flight import FLIGHT
+        from .obs.metrics import TXN_ROLLBACKS
+
+        rec = self.version_record(version)
+        with self.transaction(committer=committer
+                              or f"rollback:v{version}"):
+            for name in self.table_names():
+                wt = self.table(name)
+                target = rec["tables"].get(name, 0)
+                files = (wt._load()[target - 1]["files"] if target
+                         else [])
+                wt._commit(list(files))
+        TXN_ROLLBACKS.inc()
+        FLIGHT.record("txn_rollback", committer=committer or "rollback",
+                      to_version=version)
+        return self.current_version()
+
+    def _recover(self) -> None:
+        """Discard orphaned partial commits left by a crash: for every
+        leftover in-progress record (whose writer process is gone), each
+        table truncates back to ``max(base, published)`` — uncommitted
+        transactional work rolls back, anything a published version (or
+        a non-transactional commit predating the transaction) names is
+        never touched. Version records past CURRENT (a kill between the
+        record write and the CURRENT swing) are deleted."""
+        leftovers = sorted(glob.glob(os.path.join(
+            self.snapshots_dir, "txn-*.inprogress.json")))
+        cur = self.current_version()
+        published: dict[str, int] = {}
+        if cur:
+            published = {str(k): int(v) for k, v in
+                         self.version_record(cur)["tables"].items()}
+        for path in leftovers:
+            try:
+                with open(path) as f:
+                    rec = json.load(f)
+                base = {str(k): int(v)
+                        for k, v in rec.get("base", {}).items()}
+            except (json.JSONDecodeError, ValueError, OSError):
+                rec, base = {}, {}
+            # a LIVE writer's open transaction is not a crash: skip it
+            # (its own commit/rollback path owns the record)
+            pid = rec.get("pid")
+            if pid is not None and _pid_alive(int(pid)):
+                continue
+            for name in set(base) | set(self.table_names()):
+                wt = self.table(name)
+                if not wt.exists():
+                    continue
+                target = max(base.get(name, 0), published.get(name, 0))
+                doc = wt._load_doc()
+                if len(doc["snapshots"]) > target:
+                    doc["snapshots"] = doc["snapshots"][:target]
+                    wt._store_doc(doc)
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
+            from .obs.flight import FLIGHT
+            from .obs.metrics import TXN_RECOVERIES
+            TXN_RECOVERIES.inc()
+            FLIGHT.record("txn_recover", committer=rec.get("committer"),
+                          txn=rec.get("txn"), base_tables=len(base))
+        # orphaned version records past the commit point
+        for p in glob.glob(os.path.join(self.snapshots_dir, "v*.json")):
+            try:
+                v = int(os.path.basename(p)[1:-5])
+            except ValueError:
+                continue
+            if v > cur:
+                try:
+                    os.unlink(p)
+                except FileNotFoundError:
+                    pass
+
+    def _pin_record(self, session, at_version: int | None):
+        """The version record reader registrations resolve against, or
+        None for manifest-latest (no snapshot log, pinning disabled, or
+        this Warehouse owns the OPEN transaction — the writer session
+        must see its own uncommitted state)."""
+        if at_version is not None:
+            return self.version_record(at_version)
+        if self._txn is not None:
+            return None
+        if not getattr(session.config, "warehouse_transactions", True):
+            return None
+        cur = self.current_version()
+        return self.version_record(cur) if cur else None
+
+    def register_all(self, session, est_rows: dict[str, int] | None = None,
+                     at_version: int | None = None):
+        """Register every warehouse table on an engine Session.
+
+        With a published snapshot log (and warehouse_transactions on),
+        registrations PIN to one warehouse version: every table's files
+        come from the same version record, so a statement never sees
+        table A at version k beside table B at k+1. ``at_version`` time-
+        travels the whole warehouse to an older published version."""
         import pyarrow.dataset as pa_dataset
 
         from .engine import arrow_bridge
 
+        pin = self._pin_record(session, at_version)
+        snap_versions = getattr(session, "_table_snapshot_versions", None)
         for name in self.table_names():
             wt = self.table(name)
-            files = wt.current_files()
+            if pin is not None:
+                mv = pin["tables"].get(name, 0)
+                if snap_versions is not None:
+                    if mv > 0:
+                        snap_versions[name] = mv
+                    else:
+                        snap_versions.pop(name, None)
+                if mv <= 0:
+                    continue        # table not in the pinned snapshot
+                files = wt.files_at_version(mv)
+            else:
+                if snap_versions is not None:
+                    snap_versions.pop(name, None)
+                files = wt.current_files()
             if not files:
                 continue
             # skip tables whose snapshot is UNCHANGED since this session
@@ -525,3 +880,5 @@ class Warehouse:
             session._source_files[name] = src_key
             session._drop_cached(name)
             session._bump_generation(name)
+        if hasattr(session, "_warehouse_version"):
+            session._warehouse_version = pin["version"] if pin else None

@@ -4,7 +4,7 @@
 Drives a seeded nds_tpu/chaos campaign — N concurrent clients against a
 live QueryService with the self-healing machinery armed (circuit
 breaker, retry budget, program quarantine, optional lane watchdog) —
-while the campaign's scheduled waves arm all six FaultRegistry points,
+while the campaign's scheduled waves arm the FaultRegistry points,
 then records the three-phase evidence (baseline / armed / recovery) and
 the campaign invariants:
 
@@ -21,8 +21,18 @@ the campaign itself fires query.run per submission and stream.spawn per
 client start, the same semantics the power/throughput runners give those
 points.
 
+``--mode txn`` swaps in the TRANSACTIONAL campaign
+(chaos.run_txn_campaign): a live two-table warehouse, a writer thread
+committing atomic cross-table transactions while the clients read, and
+the ``manifest.write``/``txn.commit``/``txn.between_tables`` points
+killing commits mid-flight. Its verdict adds the snapshot-isolation
+invariants: every completed response hash-identical to SOME published
+warehouse version replayed whole, zero torn-manifest reads, at least
+one transaction landed.
+
 Usage:
   python scripts/chaos_bench.py                          # 100 clients
+  python scripts/chaos_bench.py --mode txn               # txn campaign
   python scripts/chaos_bench.py --clients 8 --queries 6 --out /tmp/c.json
 """
 from __future__ import annotations
@@ -40,6 +50,9 @@ sys.path.insert(0, REPO)
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="chaos_bench.py", description=(
         "seeded chaos campaign against the live query service"))
+    p.add_argument("--mode", default="service", choices=["service", "txn"],
+                   help="service: the classic campaign; txn: chaos "
+                        "mid-DML over a live warehouse")
     p.add_argument("--clients", type=int, default=100)
     p.add_argument("--queries", type=int, default=8,
                    help="queries per client per phase")
@@ -48,22 +61,34 @@ def main(argv=None) -> int:
                    help="firings cap per armed spec")
     p.add_argument("--probability", type=float, default=1.0)
     p.add_argument("--points", default=None,
-                   help="comma list of fault points (default: all six)")
+                   help="comma list of fault points (default: all "
+                        "registered; txn mode defaults to the commit-"
+                        "path points)")
+    p.add_argument("--dml_rounds", type=int, default=0,
+                   help="txn mode: writer transactions attempted during "
+                        "the armed phase; 0 (default) auto-scales past "
+                        "the armed points' total firing budget so at "
+                        "least one commit lands")
     p.add_argument("--watchdog", type=float, default=0.0,
                    help="device-lane watchdog budget in seconds (0 = off)")
     p.add_argument("--dump_dir", default=None,
                    help="flight-dump directory (default: a temp dir, "
                         "paths recorded in the JSON)")
-    p.add_argument("--out", default=os.path.join(REPO, "CHAOS_r01.json"))
+    p.add_argument("--out", default=None,
+                   help="output JSON (default: CHAOS_r01.json, or "
+                        "CHAOS_TXN_r01.json in txn mode)")
     a = p.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
-    from nds_tpu.chaos import (CampaignSpec, ChaosCampaign,
-                               build_demo_session, demo_pool)
+    from nds_tpu.chaos import (TXN_POINTS, CampaignSpec, ChaosCampaign,
+                               build_demo_session, demo_pool,
+                               run_txn_campaign)
 
     dump_dir = a.dump_dir or tempfile.mkdtemp(prefix="chaos_flight_")
     work_dir = tempfile.mkdtemp(prefix="chaos_data_")
+    out = a.out or os.path.join(
+        REPO, "CHAOS_TXN_r01.json" if a.mode == "txn" else "CHAOS_r01.json")
     spec_kw = dict(seed=a.seed, clients=a.clients,
                    queries_per_client=a.queries, times_per_point=a.times,
                    probability=a.probability,
@@ -71,19 +96,31 @@ def main(argv=None) -> int:
     if a.points:
         spec_kw["points"] = tuple(
             x.strip() for x in a.points.split(",") if x.strip())
+    elif a.mode == "txn":
+        # the commit path is the campaign's subject; "raise" aborts are
+        # what exercise rollback + recovery (a delayed commit still lands)
+        spec_kw["points"] = TXN_POINTS
+        spec_kw["actions"] = ("raise",)
     spec = CampaignSpec(**spec_kw)
-    session = build_demo_session(work_dir)
-    record = ChaosCampaign(spec, demo_pool()).run(session)
+    if a.mode == "txn":
+        record = run_txn_campaign(spec, work_dir, dml_rounds=a.dml_rounds)
+    else:
+        session = build_demo_session(work_dir)
+        record = ChaosCampaign(spec, demo_pool()).run(session)
     record["harness"] = {"dump_dir": dump_dir, "work_dir": work_dir}
-    with open(a.out, "w") as f:
+    with open(out, "w") as f:
         json.dump(record, f, indent=2, sort_keys=True)
         f.write("\n")
-    print(json.dumps({"out": a.out,
-                      "invariants": record["invariants"],
-                      "firings": record["firings"],
-                      "flight_dumps": record["flight_dumps"],
-                      "recovery_qps_ratio": record["recovery_qps_ratio"]},
-                     indent=2, sort_keys=True))
+    brief = {"out": out, "invariants": record["invariants"]}
+    if a.mode == "txn":
+        brief.update(dml=record["dml"],
+                     warehouse_versions=record["warehouse_versions"],
+                     txn_metrics=record["txn_metrics"])
+    else:
+        brief.update(firings=record["firings"],
+                     flight_dumps=record["flight_dumps"],
+                     recovery_qps_ratio=record["recovery_qps_ratio"])
+    print(json.dumps(brief, indent=2, sort_keys=True))
     ok = all(record["invariants"].values())
     print(f"chaos_bench: {'OK' if ok else 'INVARIANT FAILURES'}",
           file=sys.stderr)

@@ -68,6 +68,11 @@ STRICT_ZERO = (
     # rotation, or served introspection query here means the disabled
     # path grew work (one branch per statement is the whole budget)
     "system_queries", "query_log_rows", "query_log_rotations",
+    # transactional warehouse: the gate workload is query-only (no
+    # warehouse attached, no DML), so a commit, rollback, or recovery
+    # sweep here means the read path started opening transactions — the
+    # pinning-disabled/bit-identical contract broke
+    "txn_commits", "txn_rollbacks", "txn_recoveries",
 )
 
 #: report-only name suffixes: wall-clock and byte-volume metrics flake

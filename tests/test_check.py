@@ -108,7 +108,7 @@ def test_ci_pipeline_script_runs():
                          text=True, check=True)
     assert out.stdout.split() == ["native", "resilience", "static",
                                   "planner", "encoded", "kernels", "mesh",
-                                  "service", "cache", "chaos",
+                                  "service", "cache", "chaos", "txn",
                                   "metrics_gate", "test", "bench", "all"]
     subprocess.run(["bash", script, "native"], check=True, timeout=600)
     import yaml
@@ -116,8 +116,8 @@ def test_ci_pipeline_script_runs():
         wf = yaml.safe_load(f)
     assert set(wf["jobs"]) == {"native", "resilience", "static", "planner",
                                "encoded", "kernels", "mesh", "service",
-                               "cache", "chaos", "metrics_gate", "test",
-                               "bench"}
+                               "cache", "chaos", "txn", "metrics_gate",
+                               "test", "bench"}
     for job in wf["jobs"].values():
         assert any("run_ci.sh" in str(step.get("run", ""))
                    for step in job["steps"])

@@ -108,6 +108,10 @@ def test_system_table_schemas_frozen():
         "system.tables": (
             ("name", "generation", "est_rows", "columns", "unique_cols"),
             ("str", "int", "int", "int", "str")),
+        "system.snapshots": (
+            ("version", "timestamp_ms", "committer", "tables",
+             "table_count", "current", "pinned"),
+            ("int", "int", "str", "str", "int", "bool", "bool")),
     }
     assert set(st.SYSTEM_SCHEMAS) == set(expect)
     for name, (cols, dts) in expect.items():

@@ -154,6 +154,15 @@ def _parse_args(argv=None) -> argparse.Namespace:
                         "and append one flat JSONL row per completed "
                         "statement here — the bench run's self-describing "
                         "artifact for scripts/slo_report.py")
+    p.add_argument("--adaptive", action="store_true",
+                   help="enable adaptive execution (EngineConfig."
+                        "adaptive_plans, engine/feedback.py): the first "
+                        "sighting of each query observes actuals, later "
+                        "sightings right-size capacity schedules from "
+                        "them; the JSON gains an \"adaptive\" block "
+                        "(feedback counters, per-query capacity-cell and "
+                        "mem-peak deltas, result-hash identity). "
+                        "Equivalent to NDS_TPU_BENCH_ADAPTIVE=1")
     return p.parse_args(argv)
 
 
@@ -244,6 +253,14 @@ def main(argv=None) -> None:
     if args.query_log:
         config.query_log = True
         config.query_log_path = args.query_log
+    # --adaptive / NDS_TPU_BENCH_ADAPTIVE=1: feedback-driven plans; the
+    # first sighting of each query observes (morsel-bound schedules),
+    # later sightings replay right-sized ones — the A/B evidence rides
+    # in the JSON "adaptive" block
+    adaptive = args.adaptive or os.environ.get(
+        "NDS_TPU_BENCH_ADAPTIVE", "").lower() in ("1", "true", "yes", "on")
+    if adaptive:
+        config.adaptive_plans = True
     session = Session(config)
     setup_tables(session, wh_dir, "parquet")
     with open(stream_path) as f:
@@ -262,6 +279,7 @@ def main(argv=None) -> None:
     fallback_reasons: dict[str, list] = {}
     attribution: dict[str, float] = {}
     encodings: dict[str, dict] = {}
+    adaptive_evidence: dict[str, dict] = {}
     for name in units:
         sql = query_dict[name]
         # untimed oracle warm run: the first execution pays the lazy parquet
@@ -276,7 +294,19 @@ def main(argv=None) -> None:
             best_np = min(best_np, time.perf_counter() - t0)
         np_ms[name] = best_np * 1000
 
-        session.sql(sql, backend="jax", label=name)  # record (host) pass
+        t_first = session.sql(sql, backend="jax", label=name)  # record pass
+        if adaptive:
+            # the first sighting ran UNADAPTED (morsel-bound schedules,
+            # nothing observed yet): its stats and content hash are the
+            # A/B "before" side; the next sighting re-plans from the
+            # observations it just recorded
+            from nds_tpu.chaos import result_hash
+            adaptive_evidence[name] = {
+                "mem_peak_bytes_before":
+                    session.last_exec_stats.get("mem_peak_bytes", 0),
+                "bytes_uploaded_before":
+                    session.last_exec_stats.get("bytes_uploaded", 0),
+                "hash_before": result_hash(t_first)}
         session.sql(sql, backend="jax", label=name)  # compile + device run
         if session.last_fallbacks:
             # the per-operator REASON (last_exec_stats.fallback_reasons)
@@ -290,7 +320,7 @@ def main(argv=None) -> None:
         prog_ms0 = PROGRAMS.total_ms()
         for _ in range(TIMED_RUNS):
             t0 = time.perf_counter()
-            session.sql(sql, backend="jax", label=name)
+            t_last = session.sql(sql, backend="jax", label=name)
             run_s = time.perf_counter() - t0
             wall_s += run_s
             best = min(best, run_s)
@@ -327,6 +357,19 @@ def main(argv=None) -> None:
         if session.last_exec_stats.get("fallback_reasons"):
             fallback_reasons[name] = \
                 list(session.last_exec_stats["fallback_reasons"])
+        if adaptive:
+            # "after" side: the timed runs replayed the ADAPTED programs
+            # (observed-maximum capacity buckets). The response must be
+            # hash-identical to the unadapted first sighting — right-
+            # sizing is a provisioning change, never a result change
+            from nds_tpu.chaos import result_hash
+            ev = adaptive_evidence[name]
+            ev["mem_peak_bytes_after"] = \
+                session.last_exec_stats.get("mem_peak_bytes", 0)
+            ev["bytes_uploaded_after"] = \
+                session.last_exec_stats.get("bytes_uploaded", 0)
+            ev["hash_identical"] = \
+                result_hash(t_last) == ev.pop("hash_before")
         log.info(f"{name}: device {jax_ms[name]:.1f} ms, "
                  f"oracle {np_ms[name]:.1f} ms, mode {exec_modes[name]}, "
                  f"upload {upload_bytes[name] / 1e6:.2f} MB, "
@@ -407,6 +450,24 @@ def main(argv=None) -> None:
         # execution, EngineConfig.mesh_shards): wall, rows/s, collective
         # volume/time, and which queries actually streamed/sharded
         out["mesh_scaling"] = mesh_scaling
+    if adaptive:
+        # adaptive-execution A/B evidence: the feedback counters, the
+        # capacity cells the store's right-sizing removed per template
+        # (morsel-bound inflation vs adapted schedule), and the per-query
+        # before/after mem-peak + upload volume with hash identity
+        from nds_tpu.obs.metrics import (ADAPTIVE_REPLANS, FEEDBACK_HITS,
+                                         FEEDBACK_REFRESHES)
+        if session._feedback is not None:
+            session._feedback.flush()
+        out["adaptive"] = {
+            "enabled": True,
+            "feedback_hits": FEEDBACK_HITS.value,
+            "feedback_refreshes": FEEDBACK_REFRESHES.value,
+            "adaptive_replans": ADAPTIVE_REPLANS.value,
+            "applied": dict(session._feedback.applied)
+            if session._feedback is not None else {},
+            "queries": adaptive_evidence,
+        }
     if args.query_log:
         from nds_tpu.obs.query_log import QUERY_LOG
         QUERY_LOG.flush()

@@ -76,6 +76,15 @@
 #                100-client campaign and the real SF0.001 kill+resume /
 #                chaos lifecycle runs carry the slow marker and run in
 #                the full `test` stage
+#   adaptive   - adaptive execution tier-1 (tests/test_adaptive.py):
+#                feedback-store observation/right-sizing semantics, the
+#                q9-class capacity right-size with response-hash identity
+#                across sightings, under-observed ceiling-hint overflow
+#                re-recording (never mis-answering), the drift sentinel,
+#                query-log <-> feedback-store replay equivalence,
+#                crash-consistent persistence round trip, the
+#                system.plan_feedback surface, and the off-by-default
+#                strict-zero counter pins
 #   txn        - transactional warehouse tier-1: crash-consistent
 #                manifest writes (8-reader torn-read hunt), atomic
 #                multi-table commits + rollback + recovery over the
@@ -210,6 +219,15 @@ stage_chaos() {
         tests/test_lifecycle.py -q -m 'not slow')
 }
 
+stage_adaptive() {
+    # adaptive execution: observed actuals may right-size capacity
+    # schedules and flip planner decisions, but every adapted response
+    # must stay bit-identical to the unadapted one, an under-observed
+    # hint must cost a re-record (never a wrong answer), and the default
+    # (off) path must move zero feedback counters
+    (cd "$REPO" && python -m pytest tests/test_adaptive.py -q -m 'not slow')
+}
+
 stage_txn() {
     # the transactional warehouse's headline invariant, verified: no
     # torn manifest, no cross-table blend of two warehouse versions, and
@@ -250,16 +268,16 @@ run_stage() {
 }
 
 case "${1:-all}" in
-    native|resilience|static|planner|encoded|kernels|mesh|service|cache|chaos|txn|metrics_gate|test|bench)
+    native|resilience|static|planner|encoded|kernels|mesh|service|cache|chaos|adaptive|txn|metrics_gate|test|bench)
         run_stage "$1" ;;
     all)
         total0=$SECONDS
         for s in native resilience static planner encoded kernels mesh \
-                 service cache chaos txn metrics_gate test bench; do
+                 service cache chaos adaptive txn metrics_gate test bench; do
             run_stage "$s"
         done
         echo "stage all: $((SECONDS - total0))s" ;;
-    --list)     echo "native resilience static planner encoded kernels mesh service cache chaos txn metrics_gate test bench all" ;;
-    *) echo "usage: run_ci.sh [native|resilience|static|planner|encoded|kernels|mesh|service|cache|chaos|txn|metrics_gate|test|bench|all|--list]" >&2
+    --list)     echo "native resilience static planner encoded kernels mesh service cache chaos adaptive txn metrics_gate test bench all" ;;
+    *) echo "usage: run_ci.sh [native|resilience|static|planner|encoded|kernels|mesh|service|cache|chaos|adaptive|txn|metrics_gate|test|bench|all|--list]" >&2
        exit 2 ;;
 esac

@@ -237,6 +237,31 @@ class EngineConfig:
     query_log_path: str = ""
     query_log_max_bytes: int = 64 << 20
     query_log_max_files: int = 4
+    # -- adaptive execution (engine/feedback.py) ---------------------------
+    # close the loop from observed actuals to plans: a per-template
+    # feedback store records per-node actual row counts (TypeName#k),
+    # exact streamed table rows, and per-decision schedule maxima; the
+    # NEXT sighting of a template right-sizes its capacity-ladder
+    # buckets from them (instead of inflating every cap to the morsel
+    # bound) and prefers observed table rows over static est_rows. An
+    # observed cap is a CEILING HINT: an under-observed actual raises
+    # ReplayMismatch at replay and re-records eagerly — never a wrong
+    # answer. OFF by default: no store is constructed, plans and
+    # schedules are bit-identical, zero new counters.
+    # Property: nds.tpu.adaptive_plans; bench exposes --adaptive /
+    # NDS_TPU_BENCH_ADAPTIVE.
+    adaptive_plans: bool = False
+    # crash-consistent JSON document the store persists to ("" = derive
+    # a plan_feedback.json beside query_log_path when that is set,
+    # otherwise in-memory only); loaded at session attach
+    # Property: nds.tpu.feedback_path
+    feedback_path: str = ""
+    # drift sentinel: when a template's observed profile diverges from
+    # its own history past this ratio (bucket scale, either direction),
+    # the store refreshes the history and the next sighting re-records
+    # instead of replaying a stale schedule
+    # Property: nds.tpu.feedback_drift_ratio
+    feedback_drift_ratio: float = 4.0
     # -- resilience (nds_tpu/resilience.py) --------------------------------
     # per-query wall-clock budget in seconds; an overrun abandons the query
     # and records Failed (DeadlineExceeded). 0 = unbounded.

@@ -510,11 +510,21 @@ class CompiledQuery:
                     out_host, checks_host = jax.device_get((out, checks))
             t2 = _time.perf_counter()
         _verify_schedule(self.decisions, checks_host)
-        if stats is not None and self.decision_nodes:
-            rows = _node_rows(self.decisions, self.decision_nodes,
-                              [int(c) for c in checks_host])
-            if rows:
-                stats["node_rows"] = rows
+        if stats is not None:
+            checks_int = [int(c) for c in checks_host]
+            if "decision_rows" in stats:
+                # raw index-aligned per-decision actuals, exported ONLY
+                # when the caller pre-seeded the key (the adaptive
+                # streaming loop feeding the feedback store) — an
+                # unconditional write would leak the list into every
+                # in-core ExecStats.extra and break the off-mode
+                # bit-identity contract
+                stats["decision_rows"] = checks_int
+            if self.decision_nodes:
+                rows = _node_rows(self.decisions, self.decision_nodes,
+                                  checks_int)
+                if rows:
+                    stats["node_rows"] = rows
         device_ms = round((t2 - t1) * 1000, 3)
         _PROGRAMS.record_run(self.label, device_ms, first=first)
         if aot is not None:

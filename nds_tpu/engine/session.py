@@ -105,6 +105,23 @@ class Session:
                 path=self.config.query_log_path or None,
                 max_bytes=self.config.query_log_max_bytes,
                 max_files=self.config.query_log_max_files, clear=False)
+        # -- adaptive execution (engine/feedback.py) ------------------------
+        # the feedback stats store closing the loop from observed actuals
+        # back into plans: armed only by config.adaptive_plans (default off
+        # = no store, no counters, bit-identical plans). Persists beside
+        # the query log when one is configured (crash-consistent JSON), or
+        # at config.feedback_path; otherwise in-memory for the session.
+        self._feedback = None
+        if self.config.adaptive_plans:
+            from .feedback import FeedbackStore
+            fb_path = self.config.feedback_path
+            if not fb_path and self.config.query_log_path:
+                fb_path = os.path.join(
+                    os.path.dirname(self.config.query_log_path) or ".",
+                    "plan_feedback.json")
+            self._feedback = FeedbackStore(
+                path=fb_path or None,
+                drift_ratio=self.config.feedback_drift_ratio)
         self.warehouse = None  # attached via attach_warehouse for DML
         self._loaders: dict[str, Callable[[], Table]] = {}
         self._schemas: dict[str, tuple[list[str], list[str]]] = {}
@@ -610,8 +627,26 @@ class Session:
             return self._cache[key]
 
     # -- query --------------------------------------------------------------
-    def _catalog(self) -> Catalog:
-        return Catalog({name: (sch[0], sch[1], self._est_rows.get(name, 1000))
+    def _est_rows_for(self, name: str, default: int,
+                      label: Optional[str] = None) -> int:
+        """Planning-time row estimate for ``name``: the registered static
+        estimate, unless adaptive execution has OBSERVED this table's
+        streamed row count under the same query template — the feedback
+        store's ground truth then replaces the catalog guess, flipping
+        streamed-vs-in-core and late-materialization decisions from what
+        actually happened last time. ``label`` scopes the lookup (service
+        planner threads pass the ticket's label explicitly — they run
+        outside _sql_lock, so _active_label belongs to someone else)."""
+        if self._feedback is not None:
+            key = self._active_label if label is None else label
+            observed = self._feedback.table_rows(key).get(name)
+            if observed is not None:
+                return int(observed)
+        return self._est_rows.get(name, default)
+
+    def _catalog(self, label: Optional[str] = None) -> Catalog:
+        return Catalog({name: (sch[0], sch[1],
+                               self._est_rows_for(name, 1000, label))
                         for name, sch in self._schemas.items()},
                        dec_enabled=self._dec_as_int(),
                        unique_cols=dict(self._unique_cols),
@@ -1042,6 +1077,12 @@ class Session:
                     stats.pallas_fallback_reason = reason
         self.last_exec_stats_typed = stats
         self.last_exec_stats = stats.to_dict()
+        if self._feedback is not None:
+            # every completed statement's per-node actuals feed the
+            # template's profile (the query log records the same map, so
+            # replay_log over a saved JSONL reconstructs this store)
+            self._feedback.observe_nodes(self._active_label,
+                                         stats.node_stats)
         from ..obs.query_log import QUERY_LOG
         if QUERY_LOG.enabled and \
                 (self._stmt_log if log is None else log):
@@ -1080,7 +1121,7 @@ class Session:
                 cfg.late_mat_min_rows, cfg.decimal_physical, cfg.use_jax,
                 cfg.narrow_lanes, cfg.encoded_exec, tuple(cfg.mesh_shape),
                 int(cfg.mesh_shards or 0),
-                tuple(sorted(cfg.pallas_ops)))
+                tuple(sorted(cfg.pallas_ops)), bool(cfg.adaptive_plans))
 
     def _sql_streaming(self, query: str):  # lint: thread-entry (called under _sql_lock; stream-cache writes additionally take the state lock)
         """Out-of-core execution (generalized round 5, shared-scan round 7):
@@ -1108,10 +1149,26 @@ class Session:
             sent = self._stream_cache.get(query, "miss")
         if sent is None:          # known not-streamable: skip the re-plan
             return None
+        if sent != "miss" and self._feedback is not None and \
+                sent.get("fb_stamp") != \
+                self._feedback.stamp(self._active_label):
+            # drift sentinel: the feedback store's profile generation for
+            # this template moved since the cached streaming state was
+            # built (new observations at bucket scale, or a drift
+            # refresh) — replaying the stale schedule would either keep
+            # the overprovision or trip ReplayMismatch per morsel.
+            # Re-plan from the moved profile instead.
+            _metrics.ADAPTIVE_REPLANS.inc()
+            from ..obs.flight import FLIGHT
+            FLIGHT.record("adaptive_replan", label=self._active_label,
+                          reason="profile_generation")
+            with self._lock:
+                self._stream_cache.pop(query, None)
+            sent = "miss"
         if sent == "miss":
             plan = Planner(self._catalog()).plan_query(parse_sql(query))
             jobs = streaming.find_streaming_jobs(
-                plan, lambda t: self._est_rows.get(t, 0),
+                plan, lambda t: self._est_rows_for(t, 0),
                 self.config.out_of_core_min_rows)
             if not jobs:
                 with self._lock:
@@ -1154,7 +1211,11 @@ class Session:
             sent = {"plan": plan, "jobs": jobs, "groups": groups,
                     "exec": shared,
                     "gstates": [{"cqs": None, "ents": None, "fused": False}
-                                for _ in groups]}
+                                for _ in groups],
+                    # profile generation this state was planned from: a
+                    # later generation move invalidates it (drift sentinel)
+                    "fb_stamp": self._feedback.stamp(self._active_label)
+                    if self._feedback is not None else 0}
             with self._lock:
                 self._stream_cache[query] = sent
 
@@ -1221,6 +1282,12 @@ class Session:
                 enc_bytes_saved += morsels_run * (
                     lane_bytes(group.plain_lanes, cap) -
                     enc_lane_bytes(group.lanes, cap, group.encodings))
+        if self._feedback is not None:
+            # exact rows streamed per big table: ground truth the next
+            # sighting's catalog prefers over the static est_rows
+            self._feedback.observe_tables(
+                self._active_label,
+                {g["table"]: g["rows"] for g in stream_rec["groups"]})
         for ji, job in enumerate(jobs):
             if not partials[ji]:
                 with self._lock:
@@ -1426,6 +1493,48 @@ class Session:
         bytes_uploaded = 0
         rows_streamed = 0
 
+        adaptive = self._feedback is not None and mesh is None
+
+        def adapt(decisions_raw, member: int):
+            """One member's replay schedule: morsel-bound inflation, or —
+            when the feedback store holds a structurally matching profile
+            for this (template, table, member) — observed maxima instead
+            (streaming.adapt_schedule; a ceiling hint, ReplayMismatch
+            catches under-observation). Also seeds the per-decision
+            observation row from the record pass's RAW actuals."""
+            kinds = [k for k, _v in decisions_raw]
+            if not adaptive:
+                return streaming.inflate_schedule(decisions_raw,
+                                                  morsel_rows), kinds
+            state.setdefault("kinds", {})[member] = kinds
+            obs_row = [int(v) for _k, v in decisions_raw]
+            prev = state.setdefault("obs", {}).get(member)
+            if prev is not None and len(prev) == len(obs_row):
+                obs_row = [max(a, b) for a, b in zip(prev, obs_row)]
+            state["obs"][member] = obs_row
+            caps = self._feedback.member_caps(
+                self._active_label, group.table, member, kinds,
+                morsel_rows, fuse, 0)
+            adapted = streaming.adapt_schedule(decisions_raw, morsel_rows,
+                                               caps)
+            if caps is not None:
+                state["adapted"] = True
+                before = after = 0
+                for (k, v), (_k2, a) in zip(
+                        streaming.inflate_schedule(decisions_raw,
+                                                   morsel_rows), adapted):
+                    if k == "cap":
+                        before += bucket(max(int(v), 1))
+                        after += bucket(max(int(a), 1))
+                _metrics.FEEDBACK_HITS.inc()
+                from ..obs.flight import FLIGHT
+                FLIGHT.record("feedback_hit", label=self._active_label,
+                              table=group.table, member=member,
+                              cells_before=before, cells_after=after)
+                self._feedback.note_applied(self._active_label, before,
+                                            after)
+            return adapted, kinds
+
         def record_first(morsel) -> bool:
             if mesh is not None:
                 return record_first_sharded(morsel)
@@ -1435,8 +1544,7 @@ class Session:
                 _outs, decisions, scan_keys = jexec.record_plans(group.plans)
                 if jexec.fallback_nodes:
                     return False
-                decisions = streaming.inflate_schedule(decisions,
-                                                       morsel_rows)
+                decisions, _kinds = adapt(decisions, 0)
                 state["cqs"] = [CompiledQuery(
                     list(group.plans), decisions, scan_keys,
                     mesh=jexec._mesh,
@@ -1453,8 +1561,7 @@ class Session:
                     _out, decisions, scan_keys = jexec.record_plan(p)
                     if jexec.fallback_nodes:
                         return False
-                    decisions = streaming.inflate_schedule(decisions,
-                                                           morsel_rows)
+                    decisions, _kinds = adapt(decisions, bi)
                     cqs.append(CompiledQuery(
                         p, decisions, scan_keys, mesh=jexec._mesh,
                         shard_min_rows=jexec._shard_min_rows,
@@ -1530,27 +1637,79 @@ class Session:
                 return packed if packed is not None else \
                     to_device(sub, capacity=cap)
 
+        def merge_obs(member: int, actuals) -> None:
+            """Elementwise max-merge one replay/record pass's per-decision
+            actuals into the group's observation rows."""
+            if not adaptive or actuals is None:
+                return
+            row = [int(a) for a in actuals]
+            prev = state.setdefault("obs", {}).get(member)
+            if prev is not None and len(prev) == len(row):
+                row = [max(a, b) for a, b in zip(prev, row)]
+            state["obs"][member] = row
+
+        def run_one(member: int, cq, ent):
+            """One member dispatch; under adaptation the pre-seeded
+            decision_rows sentinel pulls the replay's raw check scalars
+            back out (the per-decision actuals the feedback store merges)."""
+            if not adaptive:
+                return cq.run(jexec._scans_for(ent))
+            st = {"decision_rows": None}
+            out = cq.run(jexec._scans_for(ent), stats=st)
+            merge_obs(member, st.get("decision_rows"))
+            return out
+
         def run_members():
             """Every member program against the staged buffer: one fused
             dispatch, or per-member dispatches. Returns member outputs in
             group.plans order."""
             nonlocal re_records
-            kw = {} if mesh is None else {"stats": shard_stats}
             try:
+                if mesh is not None:
+                    if state["fused"]:
+                        return list(state["cqs"][0].run(
+                            jexec._scans_for(state["ents"][0]),
+                            stats=shard_stats))
+                    return [cq.run(jexec._scans_for(ent), stats=shard_stats)
+                            for cq, ent in zip(state["cqs"], state["ents"])]
                 if state["fused"]:
-                    return list(state["cqs"][0].run(
-                        jexec._scans_for(state["ents"][0]), **kw))
-                return [cq.run(jexec._scans_for(ent), **kw)
-                        for cq, ent in zip(state["cqs"], state["ents"])]
+                    return list(run_one(0, state["cqs"][0],
+                                        state["ents"][0]))
+                return [run_one(bi, cq, ent)
+                        for bi, (cq, ent) in enumerate(zip(state["cqs"],
+                                                           state["ents"]))]
             except ReplayMismatch:
-                # a morsel genuinely exceeded the inflated schedule: run
-                # it eagerly after evicting stale record-side buffers
+                # a morsel genuinely exceeded the schedule (the inflated
+                # bound, or an adapted ceiling hint a grown actual
+                # overflowed): run it eagerly after evicting stale
+                # record-side buffers — correctness never depends on the
+                # hint. The fresh record pass's actuals feed the store so
+                # the next sighting provisions for what was seen.
                 free_dtable(jexec._scan_cache_rec.pop(mkey, None))
                 re_records += 1
+                if adaptive and state.get("adapted"):
+                    _metrics.ADAPTIVE_REPLANS.inc()
+                    from ..obs.flight import FLIGHT
+                    FLIGHT.record("adaptive_replan",
+                                  label=self._active_label,
+                                  table=group.table,
+                                  reason="schedule_overflow")
                 if state["fused"]:
-                    outs, _, _ = jexec.record_plans(group.plans)
+                    outs, d2, _ = jexec.record_plans(group.plans)
+                    if adaptive:
+                        state.setdefault("kinds", {})[0] = \
+                            [k for k, _v in d2]
+                    merge_obs(0, [int(v) for _k, v in d2])
                     return outs
-                return [jexec.record_plan(p)[0] for p in group.plans]
+                outs = []
+                for bi, p in enumerate(group.plans):
+                    out, d2, _ = jexec.record_plan(p)
+                    if adaptive:
+                        state.setdefault("kinds", {})[bi] = \
+                            [k for k, _v in d2]
+                    merge_obs(bi, [int(v) for _k, v in d2])
+                    outs.append(out)
+                return outs
 
         staged = {}
         stage_thread = None
@@ -1627,6 +1786,17 @@ class Session:
             current.pop("table", None)
         if count == 0:
             return None   # empty source: the in-core path handles it
+        if adaptive and state.get("obs"):
+            # the group's observed schedule profile: per-member per-
+            # decision maxima across every morsel of this pass (record
+            # actuals + replay check scalars), keyed on the program
+            # structure so only a like-for-like sighting consumes it
+            members = sorted(state["obs"])
+            self._feedback.observe_group(
+                self._active_label, group.table, bound=morsel_rows,
+                fused=state["fused"], shards=0,
+                kinds=[state["kinds"][m] for m in members],
+                caps=[state["obs"][m] for m in members])
         return (count, re_records, bytes_uploaded, mesh is not None,
                 host_ms, rows_streamed)
 

@@ -834,3 +834,23 @@ def inflate_schedule(decisions: list, morsel_cap: int) -> list:
     caught by the schedule check and re-recorded)."""
     return [(kind, max(int(v), morsel_cap) if kind == "cap" else v)
             for kind, v in decisions]
+
+
+def adapt_schedule(decisions: list, morsel_cap: int,
+                   observed) -> list:
+    """Feedback-driven inflate_schedule (EngineConfig.adaptive_plans):
+    each cap decision is clamped to the LARGER of its record-pass actual
+    and the feedback store's observed maximum for that decision, instead
+    of the morsel bound — the q9-class 0-group aggregate then provisions
+    the minimal ladder bucket, not the 32768-row morsel bucket, and every
+    downstream gather shrinks with it. ``observed`` is the index-aligned
+    per-decision maxima (FeedbackStore.member_caps); None (or a
+    length-drifted list — a structurally different schedule) falls back
+    to plain morsel-bound inflation. An observed cap is a CEILING HINT:
+    a later morsel exceeding it fails the replay's schedule check
+    (ReplayMismatch) and re-records eagerly, so under-observation costs a
+    re-record, never a wrong answer."""
+    if observed is None or len(observed) != len(decisions):
+        return inflate_schedule(decisions, morsel_cap)
+    return [(kind, max(int(v), int(o)) if kind == "cap" else v)
+            for (kind, v), o in zip(decisions, observed)]

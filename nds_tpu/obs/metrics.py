@@ -729,6 +729,26 @@ TXN_RECOVERIES = METRICS.counter(
     "txn_recoveries", "orphaned in-progress transactions discarded at "
     "warehouse open (crash recovery: each table back to max(base, "
     "published) — never a blend of pre- and post-commit state)")
+# Adaptive execution (engine/feedback.py): the feedback stats store
+# closing the loop from observed actuals to the next sighting's plans —
+# all exactly zero when EngineConfig.adaptive_plans is off (no store is
+# constructed; the metrics gate pins all three strict-zero on its clean,
+# adaptation-off workload)
+FEEDBACK_HITS = METRICS.counter(
+    "feedback_hits", "streamed scan groups whose capacity schedule was "
+    "right-sized from the feedback store's observed per-decision maxima "
+    "instead of morsel-bound inflation (a ceiling hint: an "
+    "under-observed actual re-records, never mis-answers)")
+FEEDBACK_REFRESHES = METRICS.counter(
+    "feedback_refreshes", "drift-sentinel refreshes: a template's "
+    "observed profile diverged from its own history past the drift "
+    "ratio, so the stale history was replaced and the generation bumped "
+    "(the next sighting re-records instead of replaying stale caps)")
+ADAPTIVE_REPLANS = METRICS.counter(
+    "adaptive_replans", "streamed re-records driven by feedback: a "
+    "cached schedule invalidated by a moved profile generation, or an "
+    "adapted (right-sized) schedule overflowed by an under-observed "
+    "actual (ReplayMismatch fallback — correctness preserved)")
 
 # Service latency distributions (histogram families): the base series
 # aggregates every query; the service also records per-(tenant, template)

@@ -59,6 +59,11 @@ COLUMNS = (
     ("mesh_shards", "int"),     # data-parallel replicas (streamed shards)
     ("morsels", "int"),         # morsels executed (streamed)
     ("mem_peak_bytes", "int"),  # device-memory high-water mark
+    ("node_stats", "str"),      # {TypeName#k: actual rows} as JSON —
+    #                             offline tooling (slo_report,
+    #                             explain_report --audit, the feedback
+    #                             store's replay_log) reconstructs
+    #                             per-node actuals without explain folders
 )
 
 COLUMN_NAMES = tuple(c for c, _ in COLUMNS)
@@ -97,6 +102,9 @@ def flatten_stats(stats, **ctx) -> dict:
         row["mesh_shards"] = stats.mesh_shards
         row["morsels"] = stats.morsels
         row["mem_peak_bytes"] = stats.mem_peak_bytes
+        if stats.node_stats:
+            row["node_stats"] = json.dumps(stats.node_stats,
+                                           sort_keys=True)
     for k, v in ctx.items():
         if k in row and v is not None:
             row[k] = v

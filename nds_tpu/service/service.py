@@ -409,6 +409,11 @@ class QueryService:
         if self.metrics_server is not None:
             self.metrics_server.stop()
             self.metrics_server = None
+        fb = getattr(self.session, "_feedback", None)
+        if fb is not None:
+            # persist observations accumulated since the last periodic
+            # flush — the next attach loads them (adaptive warm start)
+            fb.flush()
 
     def __enter__(self) -> "QueryService":
         return self.start()
@@ -650,12 +655,19 @@ class QueryService:
             if entry is not None:
                 self._plan_cache.move_to_end(ticket.query)
         if entry is None:
-            plan = Planner(session._catalog()).plan_query(
+            # label passed EXPLICITLY: planner threads run outside the
+            # session's statement lock, so _active_label belongs to
+            # whatever statement the device lane is executing — the
+            # adaptive catalog must scope observed-row lookups to THIS
+            # ticket's template
+            plan = Planner(session._catalog(ticket.label or "")).plan_query(
                 parse_sql(ticket.query))
             streams = False
             if use_jax and cfg.out_of_core:
                 jobs = streaming.find_streaming_jobs(
-                    plan, lambda t: session._est_rows.get(t, 0),
+                    plan,
+                    lambda t: session._est_rows_for(t, 0,
+                                                    ticket.label or ""),
                     cfg.out_of_core_min_rows)
                 streams = bool(jobs)
             fp = None

@@ -73,6 +73,11 @@ STRICT_ZERO = (
     # sweep here means the read path started opening transactions — the
     # pinning-disabled/bit-identical contract broke
     "txn_commits", "txn_rollbacks", "txn_recoveries",
+    # adaptive execution: the gate workload runs with adaptive_plans OFF
+    # (the default), so a feedback hit, profile refresh, or feedback-
+    # driven re-record here means the disabled path built a store or
+    # consulted one — the bit-identical off contract broke
+    "feedback_hits", "feedback_refreshes", "adaptive_replans",
 )
 
 #: report-only name suffixes: wall-clock and byte-volume metrics flake

@@ -80,10 +80,10 @@ def test_system_table_schemas_frozen():
              "trace_id", "status", "error", "wall_ms", "queue_ms",
              "plan_ms", "exec_ms", "materialize_ms", "rows",
              "bytes_uploaded", "mode", "cache_mode", "mesh_shards",
-             "morsels", "mem_peak_bytes"),
+             "morsels", "mem_peak_bytes", "node_stats"),
             ("float", "int", "str", "str", "str", "str", "int", "str",
              "str", "float", "float", "float", "float", "float", "int",
-             "int", "str", "str", "int", "int", "int")),
+             "int", "str", "str", "int", "int", "int", "str")),
         "system.metrics": (
             ("name", "kind", "value", "help"),
             ("str", "str", "float", "str")),
@@ -112,6 +112,10 @@ def test_system_table_schemas_frozen():
             ("version", "timestamp_ms", "committer", "tables",
              "table_count", "current", "pinned"),
             ("int", "int", "str", "str", "int", "bool", "bool")),
+        "system.plan_feedback": (
+            ("template", "kind", "node", "table", "rows", "sightings",
+             "refreshes", "gen"),
+            ("str", "str", "str", "str", "int", "int", "int", "int")),
     }
     assert set(st.SYSTEM_SCHEMAS) == set(expect)
     for name, (cols, dts) in expect.items():

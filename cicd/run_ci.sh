@@ -219,6 +219,19 @@ stage_chaos() {
         tests/test_lifecycle.py -q -m 'not slow')
 }
 
+stage_frontdoor() {
+    # cross-process distributed serving: the Arrow-IPC wire protocol
+    # (frame codec bounds, typed-error reconstruction, real OS-process
+    # round trips, engine-kill + connection-drop chaos), weighted-fair
+    # scheduling with morsel-boundary preemption (bit-identity preserved
+    # mid-preemption), in-flight dedup, the cross-process result-cache
+    # snapshot/invalidation handshake, and the off-mode strict-zero pins.
+    # The integration half of the file is marked slow to keep it out of
+    # the tier-1 selection; THIS stage is where it runs, so no marker
+    # filter here.
+    (cd "$REPO" && python -m pytest tests/test_frontdoor.py -q)
+}
+
 stage_adaptive() {
     # adaptive execution: observed actuals may right-size capacity
     # schedules and flip planner decisions, but every adapted response
@@ -268,16 +281,17 @@ run_stage() {
 }
 
 case "${1:-all}" in
-    native|resilience|static|planner|encoded|kernels|mesh|service|cache|chaos|adaptive|txn|metrics_gate|test|bench)
+    native|resilience|static|planner|encoded|kernels|mesh|service|cache|chaos|frontdoor|adaptive|txn|metrics_gate|test|bench)
         run_stage "$1" ;;
     all)
         total0=$SECONDS
         for s in native resilience static planner encoded kernels mesh \
-                 service cache chaos adaptive txn metrics_gate test bench; do
+                 service cache chaos frontdoor adaptive txn metrics_gate \
+                 test bench; do
             run_stage "$s"
         done
         echo "stage all: $((SECONDS - total0))s" ;;
-    --list)     echo "native resilience static planner encoded kernels mesh service cache chaos adaptive txn metrics_gate test bench all" ;;
-    *) echo "usage: run_ci.sh [native|resilience|static|planner|encoded|kernels|mesh|service|cache|chaos|adaptive|txn|metrics_gate|test|bench|all|--list]" >&2
+    --list)     echo "native resilience static planner encoded kernels mesh service cache chaos frontdoor adaptive txn metrics_gate test bench all" ;;
+    *) echo "usage: run_ci.sh [native|resilience|static|planner|encoded|kernels|mesh|service|cache|chaos|frontdoor|adaptive|txn|metrics_gate|test|bench|all|--list]" >&2
        exit 2 ;;
 esac

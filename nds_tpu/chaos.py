@@ -436,11 +436,16 @@ class ChaosCampaign:
 
 
 def build_demo_session(work_dir: str, chunk_rows: int = 8192,
-                       out_of_core_min_rows: int = 10_000):
+                       out_of_core_min_rows: int = 10_000,
+                       **engine_kwargs):
     """A self-contained chaos target: synthetic fact/dim in-core tables
     (the batched-dispatch path) plus a parquet-backed streamed table (the
     serial/morsel path, so arrow.read and device.put fire per morsel).
-    Used by scripts/chaos_bench.py and the CI campaign tests."""
+    Used by scripts/chaos_bench.py and the CI campaign tests.
+
+    Extra ``engine_kwargs`` flow into the EngineConfig (the frontdoor
+    server process enables ``query_log=True`` this way so the bench can
+    read latency from system.query_log over the wire)."""
     import os
 
     import numpy as np
@@ -450,6 +455,7 @@ def build_demo_session(work_dir: str, chunk_rows: int = 8192,
     from .config import EngineConfig
     from .engine import Session
 
+    os.makedirs(work_dir, exist_ok=True)
     rng = np.random.default_rng(23)
     n_fact, n_dim = 20_000, 50
     fact = pa.table({
@@ -465,7 +471,8 @@ def build_demo_session(work_dir: str, chunk_rows: int = 8192,
         "v": pa.array(rng.integers(0, 1000, 60_000), type=pa.int64()),
     }), spath, row_group_size=chunk_rows)
     session = Session(EngineConfig(chunk_rows=chunk_rows,
-                                   out_of_core_min_rows=out_of_core_min_rows))
+                                   out_of_core_min_rows=out_of_core_min_rows,
+                                   **engine_kwargs))
     session.register_arrow("fact", fact)
     session.register_arrow("dim", dim)
     session.register_parquet("sfact", spath)
@@ -726,6 +733,238 @@ def run_txn_campaign(spec: CampaignSpec, work_dir: str,
             "no_torn_manifest_reads":
                 not torn and not dml["refresh_errors"],
             "dml_progress": dml["commits"] >= 1,
+        },
+    }
+    return record
+
+
+# -- the topology campaign: chaos across PROCESS boundaries -----------------
+
+#: the wire-layer points the topology campaign arms (inside the ENGINE
+#: process, over the front door's remote ``chaos`` op)
+TOPOLOGY_POINTS = ("frontdoor.drop", "frontdoor.kill")
+
+
+def _spawn_frontdoor(extra_args: list, timeout_s: float = 120.0):
+    """Spawn one engine process behind scripts/frontdoor_server.py and
+    block until it prints its ``FRONTDOOR {json}`` readiness line.
+    Returns ``(Popen, info_dict)``; close the child's stdin (or
+    SIGTERM) to shut it down."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    script = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts", "frontdoor_server.py")
+    proc = subprocess.Popen(
+        [sys.executable, script] + list(extra_args),
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
+    line = proc.stdout.readline()
+    if not line.startswith("FRONTDOOR "):
+        proc.kill()
+        raise RuntimeError(f"frontdoor server failed to start: {line!r}")
+    return proc, json.loads(line.split(" ", 1)[1])
+
+
+def _topology_phase(port: int, name: str, workload: dict,
+                    baseline_hashes: dict, retries: int = 4,
+                    tenant_of=None) -> dict:
+    """Run one topology phase: each client is a thread owning its OWN
+    FlightClient (persistent socket, bounded reconnect-retry), hashes
+    come from the SERVER's canonical engine-table hash (``want_hash``) so
+    completed responses compare bit-for-bit against the serial baseline
+    across the process boundary."""
+    from .service.frontdoor import FlightClient
+
+    state = {"lock": threading.Lock(), "completed": 0,
+             "typed": Counter(), "untyped": [], "mismatches": []}
+    total = sum(len(q) for q in workload.values())
+
+    def client(cid: int, queries: list) -> None:
+        tenant = tenant_of(cid) if tenant_of else f"client{cid}"
+        try:
+            c = FlightClient("127.0.0.1", port, retries=retries)
+        except Exception as e:
+            with state["lock"]:
+                if is_typed(e):
+                    state["typed"][type(e).__name__] += len(queries)
+                else:
+                    state["untyped"].append(
+                        f"client{cid} connect: {type(e).__name__}: {e}")
+            return
+        for label, sql in queries:
+            try:
+                _table, hdr = c.query(sql, tenant=tenant, label=label,
+                                      want_hash=True)
+            except Exception as e:
+                with state["lock"]:
+                    if is_typed(e):
+                        state["typed"][type(e).__name__] += 1
+                    else:
+                        state["untyped"].append(
+                            f"{label}: {type(e).__name__}: {e}")
+                continue
+            h = hdr.get("result_hash")
+            with state["lock"]:
+                state["completed"] += 1
+                if sql in baseline_hashes and baseline_hashes[sql] != h:
+                    state["mismatches"].append(label)
+        c.close()
+
+    threads = [threading.Thread(target=client, args=(cid, qs),
+                                name=f"topo-client-{cid}", daemon=True)
+               for cid, qs in workload.items()]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    return {"phase": name, "wall_s": round(wall, 3), "queries": total,
+            "completed": state["completed"],
+            "typed_failures": dict(state["typed"]),
+            "untyped_failures": state["untyped"][:10],
+            "untyped_count": len(state["untyped"]),
+            "hash_mismatches": state["mismatches"][:10],
+            "hash_mismatch_count": len(state["mismatches"])}
+
+
+def run_topology_campaign(spec: CampaignSpec, work_dir: str) -> dict:
+    """The TOPOLOGY campaign: chaos across OS process boundaries.
+
+    One engine process serves the demo dataset over the Arrow-IPC front
+    door (fair queue + preemption + result cache armed); ``spec.clients``
+    client THREADS in this process each own a FlightClient socket. Four
+    phases through the wire:
+
+    - ``clean``    — fault-free; every server hash must equal the serial
+      in-process baseline (cross-process bit-identity);
+    - ``drop``     — ``frontdoor.drop`` armed remotely (the wire chaos
+      op): the server severs sockets instead of replying. Clients
+      reconnect-and-retry; terminal failures must be typed
+      (ConnectionDropped IS-A TransientError);
+    - ``kill``     — ``frontdoor.kill:raise#1`` armed: the engine process
+      ``os._exit``\\ s mid-query. Every client failure must still be
+      typed, and the exit signature (86) is asserted;
+    - ``recovery`` — a REPLACEMENT engine process binds the same port;
+      clients complete fully and hashes still match.
+
+    The stale-cache invariant rides the kill: a snapshot-warmed client
+    cache (``warm_cache``) from the dead server must validate FALSE
+    against the replacement (fresh epoch) — zero stale hits, re-fetch,
+    hash-identical.
+    """
+    import os
+
+    from .obs import metrics as _metrics
+    from .service.frontdoor import ConnectionDropped, FlightClient
+
+    pool = demo_pool()
+    # serial in-process baseline: the reference hash per pool text (the
+    # same canonical engine-table hashing the server ships per response)
+    base_dir = os.path.join(work_dir, "baseline")
+    os.makedirs(base_dir, exist_ok=True)
+    base_session = build_demo_session(base_dir)
+    baseline_hashes = {sql: result_hash(base_session.sql(sql))
+                       for _label, sql in pool}
+
+    server_args = ["--demo", "--allow_chaos", "--result_cache",
+                   "--fair_queue", "--preemption",
+                   "--tenant_weights", "interactive=4,batch=1"]
+    rng = random.Random(spec.seed)
+
+    def workload() -> dict:
+        return {cid: [pool[rng.randrange(len(pool))]
+                      for _ in range(spec.queries_per_client)]
+                for cid in range(spec.clients)}
+
+    phases = {}
+    proc, info = _spawn_frontdoor(server_args)
+    port = info["port"]
+    try:
+        phases["clean"] = _topology_phase(port, "clean", workload(),
+                                          baseline_hashes)
+
+        # warm a client-side cache from the live server's snapshot op:
+        # post-kill these entries are STALE by construction (new epoch)
+        cache_client = FlightClient("127.0.0.1", port, use_cache=True)
+        warm_sql = pool[0][1]
+        cache_client.query(warm_sql, label="cache_warm")
+        warmed = cache_client.warm_cache()
+        hits_before = _metrics.FRONTDOOR_CLIENT_CACHE_HITS.value
+
+        ctl = FlightClient("127.0.0.1", port)
+
+        def arm(specs: list) -> list:
+            # the server configures BEFORE replying, and an armed drop
+            # spec can sever the arm-reply itself — arming still took;
+            # the reply's "fired" lists the REPLACED batch's counts
+            try:
+                return ctl.chaos(specs).get("fired", [])
+            except ConnectionDropped:
+                return []
+
+        arm([f"frontdoor.drop:raise@{spec.probability}"
+             f"#{spec.times_per_point * spec.clients}"])
+        phases["drop"] = _topology_phase(port, "drop", workload(),
+                                         baseline_hashes)
+        fired = arm([])   # disarm; returns the drop spec's fired count
+
+        # the kill: one engine-process os._exit mid-query. Clients see
+        # severed sockets -> ConnectionDropped (typed); the phase runs
+        # to completion against a dead server (bounded retries).
+        arm(["frontdoor.kill:raise#1"])
+        ctl.close()
+        phases["kill"] = _topology_phase(port, "kill", workload(),
+                                         baseline_hashes, retries=1)
+        proc.stdin.close()
+        kill_exit = proc.wait(timeout=60)
+
+        # replacement engine process on the SAME port (SO_REUSEADDR):
+        # the surviving cache_client reconnects to a fresh epoch
+        proc, info = _spawn_frontdoor(server_args + ["--port", str(port)])
+        phases["recovery"] = _topology_phase(port, "recovery", workload(),
+                                             baseline_hashes)
+
+        # stale-cache invariant: the warmed entry must validate FALSE
+        # against the replacement server -> a real re-fetch, no client
+        # cache hit, and the re-fetched hash still matches the baseline
+        _t, hdr = cache_client.query(warm_sql, label="cache_probe",
+                                     want_hash=True)
+        stale_hits = (_metrics.FRONTDOOR_CLIENT_CACHE_HITS.value
+                      - hits_before)
+        probe_ok = (hdr.get("cache") != "client"
+                    and hdr.get("result_hash") == baseline_hashes[warm_sql])
+        cache_client.close()
+    finally:
+        try:
+            proc.stdin.close()
+            proc.wait(timeout=30)
+        except Exception:
+            proc.kill()
+
+    total_untyped = sum(p["untyped_count"] for p in phases.values())
+    total_mismatch = sum(p["hash_mismatch_count"] for p in phases.values())
+    record = {
+        "schema_version": 1,
+        "mode": "topology",
+        "spec": asdict(spec),
+        "points": list(TOPOLOGY_POINTS),
+        "phases": phases,
+        "fired": fired,
+        "kill_exit_code": kill_exit,
+        "cache": {"warmed_entries": warmed, "stale_hits": stale_hits,
+                  "revalidated_probe_ok": probe_ok},
+        "invariants": {
+            "all_failures_typed": total_untyped == 0,
+            "completed_hash_identical": total_mismatch == 0,
+            "engine_kill_observed": kill_exit == 86,
+            "zero_stale_cache_hits": stale_hits == 0 and probe_ok,
+            "recovered": phases["recovery"]["completed"]
+            == phases["recovery"]["queries"]
+            and phases["recovery"]["untyped_count"] == 0,
         },
     }
     return record

@@ -846,6 +846,44 @@ class ResultCache:
         return {n.table: gen(n.table) for n in P.iter_plan_nodes(plan)
                 if isinstance(n, P.ScanNode)}
 
+    def export_snapshot(self) -> list:
+        """Exact-tier export for CROSS-PROCESS sharing (the front door's
+        ``cache_snapshot`` op): one dict per currently-valid entry that
+        has a text alias — a client process keys its local cache on SQL
+        text, having no planner of its own. Each item carries the full
+        consistency identity beside the result: per-table catalog
+        generations and warehouse snapshot versions exactly as stored,
+        so the client can re-validate per lookup (the ``cache_validate``
+        handshake) before trusting a warmed entry. Cut under the cache
+        lock; results are the shared read-only Tables."""
+        with self._lock:
+            out = []
+            seen = set()
+            for (sql, tag), key in self._aliases.items():
+                entry = self._entries.get(key)
+                if entry is None or entry.result is None \
+                        or not self._valid(entry) or key in seen:
+                    continue
+                seen.add(key)
+                out.append({"sql": sql, "backend": tag,
+                            "gens": dict(entry.gens),
+                            "snaps": dict(entry.snaps),
+                            "result": entry.result})
+            return out
+
+    def validate_stamps(self, gens: dict, snaps: dict) -> bool:
+        """The invalidation handshake's server side: do these per-table
+        generation/snapshot stamps still match the live session? Exactly
+        the ``_valid`` test minus TTL — a client-held entry whose base
+        table re-registered or whose warehouse snapshot moved answers
+        False (the client must drop it), so N front-end processes can
+        never serve a result the engine already invalidated."""
+        gen = self.session.table_generation
+        if not all(gen(t) == g for t, g in (gens or {}).items()):
+            return False
+        snap = self.session.table_snapshot_version
+        return all(snap(t) == s for t, s in (snaps or {}).items())
+
     def snapshot_rows(self) -> list:
         """``system.result_cache`` rows: one per live entry, cut under
         the cache lock (entry id is a short stable digest of the full

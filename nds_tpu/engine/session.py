@@ -210,6 +210,15 @@ class Session:
         # sharded morsel execution (config.mesh_shards): the data-parallel
         # replica mesh streamed scan groups dispatch over, built lazily
         self._morsel_mesh_obj = None
+        # morsel-boundary preemption (service fair scheduler): the query
+        # service installs a hook the streamed path calls between scan
+        # groups / morsels; None (the default) keeps the streamed loop
+        # bit-identical to before the hook existed (one attribute read).
+        # _in_preempt guards against recursive preemption while a nested
+        # statement runs inside preempt_scope on the SAME thread (the
+        # RLocks make the nested entry legal; depth stays <= 1).
+        self._preempt_hook = None
+        self._in_preempt = False
 
     def _morsel_shards(self) -> int:
         """Effective replica count for sharded morsel execution: 0 when the
@@ -714,6 +723,53 @@ class Session:
             table = self._sql_locked(query, backend, label, plan=plan,
                                      log_row=False)
             return table, self.last_exec_stats_typed
+
+    # -- morsel-boundary preemption (service fair scheduler) ------------------
+    def _maybe_preempt(self) -> None:
+        """Yield point the streamed path calls between scan groups and
+        between morsels: when the query service installed a preemption
+        hook, hand the device lane over so short interactive tickets run
+        NOW instead of convoying behind this scan's whole wall. No hook
+        (the default) is one attribute read — the streamed loop stays
+        bit-identical to before the hook existed. Never re-enters while a
+        preempted statement is already running (depth <= 1)."""
+        hook = self._preempt_hook
+        if hook is not None and not self._in_preempt:
+            hook()
+
+    def preempt_scope(self):
+        """Context manager the service wraps around a NESTED statement
+        dispatched at a yield point: saves/restores every statement-scoped
+        attribute ``_sql_locked`` writes (the outer streamed statement
+        must resume exactly the view it had) plus the device-memory peak
+        window, and arms ``_in_preempt`` so the nested statement cannot
+        itself be preempted. The nested dispatch runs on the SAME thread
+        that holds ``_sql_lock`` — the RLock re-entry is what makes the
+        yield legal without unwinding the outer stream's state."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _scope():
+            from ..obs.profile import DEVICE_MEM
+            saved = (self.last_fallbacks, self.last_exec_stats,
+                     self.last_exec_stats_typed, self.last_profile,
+                     self._last_stream_profile, self._active_label,
+                     self._stmt_t0, self._stmt_log)
+            win = DEVICE_MEM.window_peak()
+            self._in_preempt = True
+            try:
+                yield self
+            finally:
+                self._in_preempt = False
+                (self.last_fallbacks, self.last_exec_stats,
+                 self.last_exec_stats_typed, self.last_profile,
+                 self._last_stream_profile, self._active_label,
+                 self._stmt_t0, self._stmt_log) = saved
+                # restore the outer statement's peak window: the nested
+                # statement re-marked it, and the outer stream's
+                # mem_peak_bytes must cover its own whole wall
+                DEVICE_MEM.restore_window(win)
+        return _scope()
 
     def explain_analyze(self, query: str, backend: Optional[str] = None,
                         label: Optional[str] = None):
@@ -1255,6 +1311,9 @@ class Session:
                         self._incore_partial(sent["exec"], branch)))
         for group, gstate in zip(groups, sent["gstates"]):
             sinks = [(jobs[ji], partials[ji]) for ji, _bi in group.members]
+            # scan-group boundary: yield the device lane to preempting
+            # tickets (no hook installed = one attribute read, no-op)
+            self._maybe_preempt()
             g_t0 = _time.perf_counter()
             out = self._stream_group(group, sent["exec"], gstate, sinks,
                                      prefetch_errs, shard_stats)
@@ -1732,6 +1791,10 @@ class Session:
             it = iter(morsels)
             morsel = pull(it)
             while morsel is not None:
+                # morsel boundary: the stage thread is joined and the
+                # previous morsel's partials are on the host — yield the
+                # device lane to preempting tickets before the next run
+                self._maybe_preempt()
                 if state["cqs"] is None and not record_first(morsel):
                     return None
                 if "buf" in staged:

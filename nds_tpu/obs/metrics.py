@@ -749,6 +749,37 @@ ADAPTIVE_REPLANS = METRICS.counter(
     "cached schedule invalidated by a moved profile generation, or an "
     "adapted (right-sized) schedule overflowed by an under-observed "
     "actual (ReplayMismatch fallback — correctness preserved)")
+# Distributed serving (service/frontdoor.py + fair scheduling in
+# service/service.py): all exactly zero when the front door is not
+# started and fair_queue/preemption/inflight_dedup are off (the
+# defaults) — the metrics gate pins all six strict-zero on its clean
+# in-process workload (the everything-opt-in contract)
+FRONTDOOR_REQUESTS = METRICS.counter(
+    "frontdoor_requests", "requests served by the Arrow-IPC front door "
+    "(query/ping/cache_snapshot/cache_validate frames across all client "
+    "connections; service/frontdoor.py)")
+FRONTDOOR_ERRORS = METRICS.counter(
+    "frontdoor_errors", "front-door requests answered with a typed error "
+    "frame (the resilience class + fields reconstructed client-side) or "
+    "dropped by an injected connection fault")
+SERVICE_PREEMPTIONS = METRICS.counter(
+    "service_preemptions", "interactive tickets served at a streamed "
+    "query's morsel-boundary yield point (the batch scan paused between "
+    "scan groups, the device lane ran the short query, the stream "
+    "resumed its cached state — bit-identity preserved)")
+SERVICE_INFLIGHT_DEDUP = METRICS.counter(
+    "service_inflight_dedup", "admitted tickets that parked on an "
+    "already-in-flight ticket with the same (fingerprint, params, "
+    "snapshot) key instead of re-entering the planner queue — followers "
+    "attach to the leader's shared result cell")
+RESULT_CACHE_SNAPSHOTS = METRICS.counter(
+    "result_cache_snapshots", "exact-tier result-cache exports served "
+    "over the front door (Arrow-IPC snapshot frames warming a client "
+    "process's local cache)")
+FRONTDOOR_CLIENT_CACHE_HITS = METRICS.counter(
+    "frontdoor_client_cache_hits", "client-side cache hits served from a "
+    "snapshot-warmed local result set after the per-lookup validation "
+    "handshake confirmed the entry's generations are still current")
 
 # Service latency distributions (histogram families): the base series
 # aggregates every query; the service also records per-(tenant, template)

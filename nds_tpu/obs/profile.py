@@ -111,6 +111,15 @@ class DeviceMemTracker:
         with self._lock:
             return self._win_peak
 
+    def restore_window(self, saved_peak: int) -> None:
+        """Re-open a suspended statement's peak window (the service's
+        morsel-boundary preemption nests a statement inside another):
+        the resumed window's peak is the max of what the outer statement
+        had already seen and everything since — the outer statement's
+        mem_peak_bytes keeps covering its whole wall."""
+        with self._lock:
+            self._win_peak = max(saved_peak, self._win_peak)
+
     def reset(self) -> None:
         """Zero all accounting (tests only)."""
         with self._lock:

@@ -64,6 +64,9 @@ COLUMNS = (
     #                             explain_report --audit, the feedback
     #                             store's replay_log) reconstructs
     #                             per-node actuals without explain folders
+    ("preempted", "int"),       # interactive tickets served at this
+    #                             streamed query's morsel-boundary yield
+    #                             points (0 outside the fair scheduler)
 )
 
 COLUMN_NAMES = tuple(c for c, _ in COLUMNS)

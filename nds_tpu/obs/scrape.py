@@ -41,6 +41,15 @@ from typing import Optional
 from .metrics import METRICS
 from .log import get_logger
 
+#: hard caps on the operator surface: longest accepted URL (the request
+#: line IS the whole query payload on this GET-only endpoint) and the
+#: largest Content-Length a request may declare — oversized requests are
+#: refused with a typed JSON status, never buffered or half-parsed
+MAX_URL_BYTES = 16 << 10
+MAX_BODY_BYTES = 64 << 10
+#: parse_qs field cap: bounds query-string parsing work per request
+MAX_QUERY_FIELDS = 32
+
 
 class _Handler(BaseHTTPRequestHandler):
     server_version = "nds-tpu-obs/1"
@@ -66,6 +75,18 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):                                      # noqa: N802
         try:
+            if len(self.requestline) > MAX_URL_BYTES:
+                self._send_json(414, {"error": "request line too long",
+                                      "limit_bytes": MAX_URL_BYTES})
+                return
+            try:
+                declared = int(self.headers.get("Content-Length") or 0)
+            except (TypeError, ValueError):
+                declared = -1
+            if declared < 0 or declared > MAX_BODY_BYTES:
+                self._send_json(413, {"error": "request body too large",
+                                      "limit_bytes": MAX_BODY_BYTES})
+                return
             parsed = urllib.parse.urlsplit(self.path)
             route = parsed.path.rstrip("/") or "/"
             if route == "/metrics":
@@ -88,7 +109,14 @@ class _Handler(BaseHTTPRequestHandler):
                 pass
 
     def _do_query(self, query_string: str) -> None:
-        params = urllib.parse.parse_qs(query_string)
+        try:
+            params = urllib.parse.parse_qs(
+                query_string, max_num_fields=MAX_QUERY_FIELDS)
+        except ValueError as e:
+            # malformed or abusive query string is a 400 with a typed JSON
+            # body — never a traceback, never a 500
+            self._send_json(400, {"error": f"malformed query string: {e}"})
+            return
         sql = (params.get("sql") or [""])[0].strip()
         if not sql:
             self._send_json(400, {"error": "missing ?sql= parameter"})

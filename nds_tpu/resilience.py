@@ -476,9 +476,18 @@ class CircuitBreaker:
 #:   txn.between_tables - a SECOND distinct table joining an open
 #:                        warehouse transaction (the mid-commit kill
 #:                        window: table A committed, table B untouched)
+#:   frontdoor.drop     - a front-door connection handler about to write
+#:                        a response (service/frontdoor.py): a raise-spec
+#:                        makes the server sever the socket instead —
+#:                        the client sees an abrupt EOF mid-frame
+#:   frontdoor.kill     - the engine process serving a front-door query
+#:                        (fired before dispatch): a raise-spec makes the
+#:                        server process exit hard (os._exit) — the
+#:                        chaos topology campaign's mid-query kill
 FAULT_POINTS = ("arrow.read", "device.put", "jax.compile", "jax.execute",
                 "stream.spawn", "query.run",
-                "manifest.write", "txn.commit", "txn.between_tables")
+                "manifest.write", "txn.commit", "txn.between_tables",
+                "frontdoor.drop", "frontdoor.kill")
 
 #: default sleep for a ``hang`` spec with no explicit duration: long enough
 #: that only a deadline/supervisor kill ends the attempt.

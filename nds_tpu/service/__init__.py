@@ -20,12 +20,28 @@ Public surface:
 Self-healing (all opt-in via ServiceConfig, exercised by ``nds_tpu/chaos``
 campaigns): circuit breaker at admission, bounded transient-failure retry
 budget, compiled-program quarantine, and a device-lane watchdog.
+
+Distributed serving (``service/frontdoor.py``, all opt-in):
+
+- :class:`FrontDoorServer` — the Arrow-IPC wire front door: N client
+  PROCESSES submit SQL + tenant + deadline to one engine process over a
+  stdlib socket; serialization runs on per-connection threads off the
+  device lane; admission/breakers/deadlines/batching reused unchanged.
+- :class:`FlightClient` — the thin synchronous client (persistent
+  connection, typed-error reconstruction, bounded reconnect-retry, and
+  an optional snapshot-warmed local result cache with a per-use
+  invalidation handshake).
+- :class:`ConnectionDropped` / :class:`RemoteQueryError` — the wire
+  layer's typed failures (transient / unknown-remote-class).
 """
 from ..engine.result_cache import ResultCache, ResultCacheConfig
 from ..resilience import (AdmissionRejected, CircuitBreakerConfig,
                           CircuitOpen, DeadlineExceeded)
+from .frontdoor import (ConnectionDropped, FlightClient, FrontDoorServer,
+                        RemoteQueryError)
 from .service import QueryService, ServiceConfig, Ticket
 
 __all__ = ["QueryService", "ServiceConfig", "Ticket", "AdmissionRejected",
            "CircuitBreakerConfig", "CircuitOpen", "DeadlineExceeded",
-           "ResultCache", "ResultCacheConfig"]
+           "ResultCache", "ResultCacheConfig", "FrontDoorServer",
+           "FlightClient", "ConnectionDropped", "RemoteQueryError"]

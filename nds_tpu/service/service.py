@@ -140,6 +140,34 @@ class ServiceConfig:
     #: evict them after executor.QUARANTINE_STRIKES (re-recorded fresh on
     #: next use instead of poisoning every adopter)
     quarantine: bool = True
+    # -- weighted-fair scheduling + morsel-boundary preemption (all off
+    #    by default: the plain service keeps the FIFO ready queue and
+    #    never installs the session preemption hook — bit-identical to
+    #    before these knobs existed) --------------------------------------
+    #: replace the FIFO ready queue with per-tenant weighted-fair queues
+    #: (virtual-time WFQ): each tenant accrues virtual time at
+    #: cost/weight per second of device lane consumed, and the lane
+    #: always serves the least-served active tenant next — a saturating
+    #: batch tenant can no longer convoy an interactive tenant's queue
+    fair_queue: bool = False
+    #: relative service weights per tenant {tenant: weight}; unlisted
+    #: tenants weigh 1.0 (higher weight = larger device-lane share)
+    tenant_weights: dict = field(default_factory=dict)
+    #: let streamed dispatches YIELD the device lane at morsel/scan-group
+    #: boundaries: the session calls back into the service between scan
+    #: groups, non-streamed ready tickets run right there on the lane
+    #: thread (the stream's cached state resumes untouched — responses
+    #: stay bit-identical to serial execution), then the scan continues
+    preemption: bool = False
+    #: most tickets served per yield point (bounds how long one morsel
+    #: boundary can hold the stream)
+    preempt_max: int = 2
+    #: in-flight dedup at the planner stage: a ticket whose (fingerprint,
+    #: params, catalog generation, snapshot version) matches an already-
+    #: admitted in-flight ticket parks on that leader's shared result
+    #: cell instead of re-entering the ready queue — the leader executes
+    #: once, followers attach (service_inflight_dedup counts them)
+    inflight_dedup: bool = False
     #: semantic result cache (engine/result_cache.ResultCacheConfig):
     #: exact cross-client reuse at ADMISSION (a repeat dashboard text
     #: touches neither planner thread nor device lane), subsumption
@@ -201,6 +229,19 @@ class Ticket:
         self.fp: Optional[str] = None
         self.pvalues: tuple = ()
         self.use_jax = True
+        #: planner verdict: the plan takes the streamed morsel path —
+        #: streamed tickets are never chosen as preemptors (they would
+        #: hold the lane for a whole scan at the yield point) and carry
+        #: the yield points themselves
+        self.streams = False
+        #: tickets served at THIS dispatch's morsel-boundary yield points
+        #: (nonzero only for streamed dispatches under preemption; lands
+        #: in the ticket's query-log row)
+        self.preempted = 0
+        #: in-flight dedup: the leader's registry key while it owns one,
+        #: and the follower tickets parked on its result cell
+        self._dedup_key = None
+        self._dedup_followers: list = []
         #: serial dispatch attempts (the retry budget requeues transient
         #: failures until this reaches ServiceConfig.ticket_attempts)
         self.attempts = 0
@@ -214,11 +255,13 @@ class Ticket:
 
     # -- stage transitions (methods so stage loops stay lint-clean:
     #    single-owner handoff, no shared-state writes in thread targets) --
-    def set_planned(self, plan, fp, pvalues, use_jax) -> None:
+    def set_planned(self, plan, fp, pvalues, use_jax,
+                    streams: bool = False) -> None:
         self.plan = plan
         self.fp = fp
         self.pvalues = tuple(pvalues)
         self.use_jax = use_jax
+        self.streams = streams
         self.template = fp[:12] if fp else self.label
 
     def picked_up(self) -> None:
@@ -310,6 +353,105 @@ class _PlannedQuery:
         self.streams = streams
 
 
+class _FairReadyQueue:
+    """Per-tenant weighted-fair ready queue (virtual-time WFQ).
+
+    Each tenant keeps a FIFO of its own tickets plus a virtual time that
+    advances by ``cost / weight`` whenever the device lane charges it
+    (``charge``); ``popleft`` always serves the head of the least-served
+    active tenant, ties broken by activation order — so a tenant with
+    weight 2 earns twice the lane share of a weight-1 tenant, and an
+    interactive tenant that shows up mid-saturation is served after at
+    most one in-flight dispatch instead of behind the whole backlog.
+
+    A tenant REACTIVATING after idle resumes at the current virtual
+    floor, never below it: sleeping earns no credit (no post-idle burst)
+    and costs none (no starvation).
+
+    Deque-compatible surface (append/popleft/clear/len/iter/bool): every
+    existing consumer of the FIFO ready deque — the lane drain, requeue,
+    close()'s drop sweep, the metrics-gate depth probe — works unchanged.
+    All methods are called under the service's ``_cv`` lock."""
+
+    def __init__(self, weights: Optional[dict] = None):
+        self._weights = dict(weights or {})
+        self._queues: "OrderedDict[str, deque]" = OrderedDict()
+        self._vtime: dict = {}        # tenant -> accrued virtual time
+        self._floor = 0.0             # vtime of the last tenant served
+
+    def _weight(self, tenant: str) -> float:
+        try:
+            w = float(self._weights.get(tenant, 1.0))
+        except (TypeError, ValueError):
+            w = 1.0
+        return w if w > 0 else 1e-6
+
+    def append(self, ticket) -> None:
+        q = self._queues.get(ticket.tenant)
+        if q is None:
+            q = self._queues[ticket.tenant] = deque()
+        if not q:
+            # (re)activation: join at the floor, keeping whatever debt
+            # the tenant already accrued above it
+            self._vtime[ticket.tenant] = max(
+                self._vtime.get(ticket.tenant, 0.0), self._floor)
+        q.append(ticket)
+
+    def _pick(self) -> Optional[str]:
+        best, best_v = None, None
+        for tenant, q in self._queues.items():
+            if not q:
+                continue
+            v = self._vtime.get(tenant, 0.0)
+            if best is None or v < best_v:
+                best, best_v = tenant, v
+        return best
+
+    def popleft(self):
+        tenant = self._pick()
+        if tenant is None:
+            raise IndexError("pop from an empty ready queue")
+        return self._take(tenant, 0)
+
+    def pop_preemptable(self):
+        """First NON-STREAMED ticket in fair order, or None: the yield
+        point serves short in-core tickets only — a streamed preemptor
+        would hold the paused stream for a whole scan."""
+        for tenant in sorted(self._queues,
+                             key=lambda t: self._vtime.get(t, 0.0)):
+            for i, ticket in enumerate(self._queues[tenant]):
+                if not ticket.streams:
+                    return self._take(tenant, i)
+        return None
+
+    def _take(self, tenant: str, i: int):
+        q = self._queues[tenant]
+        ticket = q[i]
+        del q[i]
+        if not q:
+            del self._queues[tenant]
+        self._floor = max(self._floor, self._vtime.get(tenant, 0.0))
+        return ticket
+
+    def charge(self, tenant: str, cost_s: float) -> None:
+        """Account ``cost_s`` seconds of device lane to ``tenant``."""
+        self._vtime[tenant] = (self._vtime.get(tenant, 0.0)
+                               + max(0.0, cost_s) / self._weight(tenant))
+
+    def clear(self) -> None:
+        self._queues.clear()
+
+    def __len__(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    def __bool__(self) -> bool:
+        return any(self._queues.values())
+
+    def __iter__(self):
+        for q in self._queues.values():
+            yield from q
+
+
 class QueryService:
     """Long-lived async query service over one shared Session.
 
@@ -332,8 +474,18 @@ class QueryService:
         self.config = config or ServiceConfig()
         self._cv = threading.Condition()
         self._intake: deque = deque()     # admitted, awaiting planning
-        self._ready: deque = deque()      # planned, awaiting the device lane
+        # planned, awaiting the device lane: FIFO deque by default;
+        # fair_queue swaps in the per-tenant weighted-fair queue (same
+        # surface — every drain/requeue/probe site works on either)
+        self._ready = _FairReadyQueue(self.config.tenant_weights) \
+            if self.config.fair_queue else deque()
         self._pending = 0                 # admitted but unfinished
+        #: in-flight dedup registry: dedup key -> leader ticket
+        self._inflight: dict = {}
+        #: tickets served at yield points since the CURRENT outer
+        #: streamed dispatch began (single-writer: the thread running
+        #: the outer dispatch is the thread its yield points run on)
+        self._preempt_served = 0
         self._plan_cache: "OrderedDict" = OrderedDict()
         self._plan_cache_key = None       # config/generation fingerprint
         self._hold = False                # test/drain hook: park the lane
@@ -376,6 +528,11 @@ class QueryService:
                               name="svc-device-lane")]
         for t in self._threads:
             t.start()
+        if self.config.preemption:
+            # the streamed path's morsel-boundary yield points call back
+            # into this service (Session._maybe_preempt); installing the
+            # hook is what arms them — no hook, no behavior change
+            self.session._preempt_hook = self._preempt_tick
         if self.config.metrics_port is not None \
                 and self.metrics_server is None:
             # live scrape endpoint for the service's lifetime: /metrics,
@@ -406,6 +563,8 @@ class QueryService:
         for t in self._threads:
             t.join(timeout=10)
         self._threads = []
+        if self.session._preempt_hook == self._preempt_tick:
+            self.session._preempt_hook = None
         if self.metrics_server is not None:
             self.metrics_server.stop()
             self.metrics_server = None
@@ -626,10 +785,39 @@ class QueryService:
                 if hit is not None:
                     self._finish_cached(ticket, hit)
                     continue
+            if self.config.inflight_dedup and ticket.fp is not None \
+                    and self._attach_inflight(ticket):
+                continue
             ticket.begin_wait()
             with self._cv:
                 self._ready.append(ticket)
                 self._cv.notify_all()
+
+    def _attach_inflight(self, ticket: Ticket) -> bool:
+        """In-flight dedup: park ``ticket`` on an already-admitted
+        in-flight leader computing the identical result. The key is the
+        full result identity — parameterized-plan fingerprint, parameter
+        vector, backend, catalog generation, warehouse snapshot — so a
+        registration or commit between the two admissions makes distinct
+        keys (never a stale share). Returns True when parked (the ticket
+        must not enter the ready queue); the leader's ``_finish_ticket``
+        drains followers on every terminal outcome."""
+        session = self.session
+        key = (ticket.fp, ticket.pvalues,
+               "jax" if ticket.use_jax else "numpy",
+               session._generation, session._warehouse_version)
+        with self._cv:
+            leader = self._inflight.get(key)
+            if leader is not None and not leader.done():
+                leader._dedup_followers.append(ticket)
+            else:
+                self._inflight[key] = ticket
+                ticket._dedup_key = key  # lint: lock-exempt (written under _cv; read/cleared by _finish_ticket under _cv)
+                return False
+        _metrics.SERVICE_INFLIGHT_DEDUP.inc()
+        FLIGHT.record("dedup", label=ticket.label, tenant=ticket.tenant,
+                      leader=leader.label, template=ticket.template)
+        return True
 
     def _plan_ticket(self, ticket: Ticket) -> None:
         """Parse/plan/parameterize one query via the cross-client plan
@@ -689,7 +877,7 @@ class QueryService:
                 while len(self._plan_cache) > self.config.plan_cache_entries:
                     self._plan_cache.popitem(last=False)
         ticket.set_planned(entry.plan, None if entry.streams else entry.fp,
-                           entry.pvalues, use_jax)
+                           entry.pvalues, use_jax, streams=entry.streams)
 
     # -- device lane ---------------------------------------------------------
     def _device_loop(self) -> None:
@@ -746,6 +934,55 @@ class QueryService:
                 serial.extend(members)
         for t in serial:
             self._serve_serial(t)
+
+    def _charge_tenant(self, tenant: str, cost_s: float) -> None:
+        """Account one dispatch's device-lane wall to its tenant's
+        weighted-fair virtual time (no-op under the FIFO queue)."""
+        if not self.config.fair_queue:
+            return
+        with self._cv:
+            self._ready.charge(tenant, cost_s)
+
+    def _preempt_tick(self) -> None:
+        """One morsel-boundary yield point (Session._maybe_preempt calls
+        here between scan groups / morsels, ON the thread that holds the
+        session's statement lock mid-stream): serve up to ``preempt_max``
+        non-streamed ready tickets right now, then let the stream resume
+        its cached state. Each nested dispatch runs inside
+        ``session.preempt_scope()`` — statement-scoped session state is
+        saved/restored and the RLock re-entry on this same thread is what
+        makes the nested statement legal — and never under the lane
+        watchdog (``run_with_deadline`` would move the dispatch to a
+        thread that cannot re-enter this thread's RLock)."""
+        served = 0
+        while served < max(1, self.config.preempt_max):
+            with self._cv:
+                if not self._running or self._hold:
+                    return
+                ticket = self._pop_preemptable_locked()
+            if ticket is None:
+                return
+            if self._expire_if_late(ticket, "preempting"):
+                continue
+            _metrics.SERVICE_PREEMPTIONS.inc()
+            FLIGHT.record("preempt", label=ticket.label,
+                          tenant=ticket.tenant, template=ticket.template)
+            with self.session.preempt_scope():
+                self._serve_serial(ticket, preempted=True)
+            self._preempt_served += 1
+            served += 1
+
+    def _pop_preemptable_locked(self):
+        """First non-streamed ready ticket (fair order under the WFQ,
+        arrival order under the FIFO deque), or None. Caller holds _cv."""
+        ready = self._ready
+        if hasattr(ready, "pop_preemptable"):
+            return ready.pop_preemptable()
+        for ticket in ready:
+            if not ticket.streams:
+                ready.remove(ticket)
+                return ticket
+        return None
 
     def _serve_batched(self, fp: str, members: list) -> bool:
         """One compiled program over the group's stacked parameter vectors;
@@ -821,6 +1058,9 @@ class QueryService:
             sp.end()
             t.exec_ms = round(exec_ms, 3)
             _observe_phase("service_exec_ms", exec_ms, t.tenant, t.template)
+            # fair accounting: the batch's wall splits evenly across its
+            # members — each tenant pays for the share it rode
+            self._charge_tenant(t.tenant, exec_ms / 1000.0 / len(members))
         device_ms = exec_stats.get("device_ms")
         with _metrics.METRICS.locked():
             # one logical event, three counters: the shared value lock
@@ -881,7 +1121,8 @@ class QueryService:
             session._finish_exec_stats(last, log=False)
         return True
 
-    def _serve_serial(self, ticket: Ticket) -> None:
+    def _serve_serial(self, ticket: Ticket,
+                      preempted: bool = False) -> None:
         """The normal Session path (record/adopt/replay, streaming,
         segmentation, host fallback) with the service's pre-built plan —
         result + per-query stats captured atomically. Self-healing rides
@@ -889,10 +1130,20 @@ class QueryService:
         session locks, the power.py recovery move) and fails typed while
         neighbors proceed; a transient failure inside the retry budget
         requeues off the lane instead of failing the client; repeated
-        failures through a shared program strike it toward quarantine."""
+        failures through a shared program strike it toward quarantine.
+
+        preempted=True: this dispatch runs NESTED at another dispatch's
+        morsel-boundary yield point (same thread, inside preempt_scope) —
+        the lane watchdog is bypassed (its worker thread could not
+        re-enter this thread's session RLock) and the preemption counter
+        attribution belongs to the OUTER dispatch."""
         ticket.attempts += 1
         wait = ticket.mark_started()
         _metrics.SERVICE_QUEUE_WAIT_MS.inc(wait)
+        if not preempted:
+            # fresh attribution window: yield points fired during THIS
+            # dispatch accumulate here (same-thread single-writer)
+            self._preempt_served = 0
         # generation snapshot BEFORE dispatch: a registration racing the
         # execution then stamps the stored entry stale instead of current
         gens = None
@@ -905,8 +1156,11 @@ class QueryService:
             # the ticket root reaches down to parse/plan/morsel spans
             with TRACER.span("service/dispatch", cat="service",
                              parent=ticket.trace_id, label=ticket.label):
-                table, stats = self._dispatch_serial(ticket)
+                table, stats = self._dispatch_serial(ticket, preempted)
         except Exception as e:
+            self._charge_tenant(ticket.tenant, time.perf_counter() - t0)
+            if not preempted:
+                ticket.preempted = self._preempt_served
             if self.config.quarantine and ticket.fp is not None:
                 from ..engine.jax_backend.executor import \
                     strike_shared_program
@@ -921,9 +1175,13 @@ class QueryService:
         if self.config.quarantine and ticket.fp is not None:
             from ..engine.jax_backend.executor import absolve_shared_program
             absolve_shared_program(ticket.fp)
-        ticket.exec_ms = round((time.perf_counter() - t0) * 1000.0, 3)
+        exec_s = time.perf_counter() - t0
+        ticket.exec_ms = round(exec_s * 1000.0, 3)
         _observe_phase("service_exec_ms", ticket.exec_ms,
                        ticket.tenant, ticket.template)
+        self._charge_tenant(ticket.tenant, exec_s)
+        if not preempted:
+            ticket.preempted = self._preempt_served
         if stats is None:
             stats = ExecStats(mode="host")
         stats.queue_wait_ms = wait
@@ -934,13 +1192,18 @@ class QueryService:
                                     use_jax=ticket.use_jax, gens=gens)
         self._finish_ticket(ticket, result=table, stats=stats)
 
-    def _dispatch_serial(self, ticket: Ticket):
+    def _dispatch_serial(self, ticket: Ticket, preempted: bool = False):
         """One serial session dispatch, optionally under the device-lane
         watchdog (ServiceConfig.dispatch_timeout_s): on overrun the stuck
         worker is ABANDONED, the session swaps in fresh statement locks
         (power.py's deadline-kill recovery), the trip is flight-dumped,
         and typed DeadlineExceeded propagates — the lane moves on instead
-        of wedging every queued neighbor behind one hung dispatch."""
+        of wedging every queued neighbor behind one hung dispatch.
+
+        Preempted dispatches NEVER take the watchdog: run_with_deadline
+        executes on a worker thread, and the session's statement RLock —
+        already held by the paused stream on THIS thread — is not
+        reentrant across threads; the nested dispatch must stay here."""
         cfg = self.config
 
         def run():
@@ -948,7 +1211,7 @@ class QueryService:
                 ticket.query, backend=ticket.backend,
                 label=ticket.label, plan=ticket.plan)
 
-        if cfg.dispatch_timeout_s <= 0:
+        if preempted or cfg.dispatch_timeout_s <= 0:
             return run()
         try:
             return run_with_deadline(run, cfg.dispatch_timeout_s,
@@ -1005,6 +1268,17 @@ class QueryService:
                        stats: Optional[ExecStats] = None,
                        error: Optional[BaseException] = None,
                        materialize=None) -> None:
+        followers = None
+        if ticket._dedup_key is not None:
+            # release the in-flight leadership and take the follower list
+            # atomically: a racing _attach_inflight either saw the leader
+            # undone (parked here, drained below) or finds the registry
+            # slot free and becomes the next leader
+            with self._cv:
+                self._inflight.pop(ticket._dedup_key, None)
+                ticket._dedup_key = None
+                followers = ticket._dedup_followers
+                ticket._dedup_followers = []
         err_name = type(error).__name__ if error is not None else None
         ticket.close_stage_spans(error=err_name)
         latency_ms = round(
@@ -1021,7 +1295,7 @@ class QueryService:
                 trace_id=ticket.trace_id or None, wall_ms=latency_ms,
                 queue_ms=ticket.queue_wait_ms, plan_ms=ticket.plan_ms,
                 exec_ms=ticket.exec_ms, status=err_name,
-                error=error,
+                error=error, preempted=ticket.preempted,
                 rows=getattr(result, "num_rows", None))
         if error is not None:
             ticket.fail(error)
@@ -1056,3 +1330,22 @@ class QueryService:
             self._pending -= 1
             _metrics.SERVICE_QUEUE_DEPTH.set(self._pending)
             self._cv.notify_all()
+        if followers:
+            # drain the parked followers on the leader's terminal
+            # outcome: shared result cell (the batched-ticket contract —
+            # read-only Table, one deferred materialization) or the same
+            # typed error; a follower whose own deadline lapsed while
+            # parked fails on ITS budget, not the leader's result
+            for f in followers:
+                if self._expire_if_late(f, "deduped on an in-flight "
+                                           "leader"):
+                    continue
+                if error is not None:
+                    self._finish_ticket(f, error=error)
+                else:
+                    fwait = f.mark_started()
+                    _metrics.SERVICE_QUEUE_WAIT_MS.inc(fwait)
+                    fstats = ExecStats(mode="deduped", queue_wait_ms=fwait,
+                                       trace_id=f.trace_id or None)
+                    self._finish_ticket(f, result=result, stats=fstats,
+                                        materialize=materialize)

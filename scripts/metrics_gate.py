@@ -78,6 +78,14 @@ STRICT_ZERO = (
     # driven re-record here means the disabled path built a store or
     # consulted one — the bit-identical off contract broke
     "feedback_hits", "feedback_refreshes", "adaptive_replans",
+    # distributed serving front door: the gate workload is in-process
+    # (no FrontDoorServer, fair_queue/preemption/inflight_dedup all at
+    # their off defaults), so any wire request, preemption, dedup share,
+    # cache snapshot export, or client-side cache hit here means the
+    # disabled path grew serving work — the bit-identical off contract
+    "frontdoor_requests", "frontdoor_errors", "service_preemptions",
+    "service_inflight_dedup", "result_cache_snapshots",
+    "frontdoor_client_cache_hits",
 )
 
 #: report-only name suffixes: wall-clock and byte-volume metrics flake

@@ -22,7 +22,16 @@ Reported per tenant (and overall):
   log's last row, ``(bad fraction in window) / (1 - target)`` — the
   standard error-budget burn multiple (1.0 = burning exactly the
   budget; >>1 = paging territory; the 5m/1h pair is the classic
-  fast+slow multiwindow alert input).
+  fast+slow multiwindow alert input);
+- fairness: queue-wait share (sum queue_ms / sum wall_ms — how much of
+  a tenant's perceived latency was spent WAITING for the lane),
+  preemption count (sum of the ``preempted`` column: interactive
+  tickets served inside this tenant's streamed morsel-boundary yields),
+  and weight attainment — (tenant's share of total exec_ms) / (tenant's
+  share of total weight, via ``--weights a=4,b=1``; default weight 1).
+  Attainment ≈ 1.0 means the weighted-fair scheduler delivered the
+  configured share; a saturating tenant >> its weight share under FIFO
+  is exactly the convoy the fair queue removes.
 
 Usage:
   python scripts/slo_report.py run/query_log.jsonl
@@ -60,14 +69,17 @@ def _sql_count(session, where: str = "") -> dict[str, int]:
 
 
 def build_report(session, slo_ms: float, target: float,
-                 windows: list[float]) -> dict:
+                 windows: list[float],
+                 weights: dict[str, float] | None = None) -> dict:
+    weights = weights or {}
     total = _sql_count(session)
     ok = _sql_count(session, "WHERE status = 'ok'")
     good = _sql_count(session,
                       f"WHERE status = 'ok' AND wall_ms <= {slo_ms}")
     # exact percentiles need the raw samples; fetch them through the same
     # SQL surface (one pass, grouped host-side)
-    raw = _fetch(session, "SELECT tenant, status, wall_ms, ts "
+    raw = _fetch(session, "SELECT tenant, status, wall_ms, queue_ms, "
+                          "exec_ms, preempted, ts "
                           "FROM system.query_log")
     by_tenant: dict[str, list[float]] = {}
     for r in raw:
@@ -81,6 +93,20 @@ def build_report(session, slo_ms: float, target: float,
                 if (r["tenant"] or "") == tenant
                 and (r["ts"] or 0) >= since]
 
+    # fairness inputs: per-tenant sums over the whole log
+    q_sum: dict[str, float] = {}
+    w_sum: dict[str, float] = {}
+    e_sum: dict[str, float] = {}
+    p_sum: dict[str, int] = {}
+    for r in raw:
+        t = r["tenant"] or ""
+        q_sum[t] = q_sum.get(t, 0.0) + (r["queue_ms"] or 0.0)
+        w_sum[t] = w_sum.get(t, 0.0) + (r["wall_ms"] or 0.0)
+        e_sum[t] = e_sum.get(t, 0.0) + (r["exec_ms"] or 0.0)
+        p_sum[t] = p_sum.get(t, 0) + int(r["preempted"] or 0)
+    exec_total = sum(e_sum.values())
+    weight_total = sum(float(weights.get(t, 1.0)) for t in total) or 1.0
+
     tenants = sorted(total)
     out_rows = []
     budget = max(1e-9, 1.0 - target)
@@ -90,11 +116,20 @@ def build_report(session, slo_ms: float, target: float,
             n_ok = sum(ok.values())
             n_good = sum(good.values())
             lat = sorted(x for v in by_tenant.values() for x in v)
+            qs, ws = sum(q_sum.values()), sum(w_sum.values())
+            preempt = sum(p_sum.values())
+            attain_w = None                  # share-of-total is trivially 1
         else:
             n = total.get(tenant, 0)
             n_ok = ok.get(tenant, 0)
             n_good = good.get(tenant, 0)
             lat = sorted(by_tenant.get(tenant, []))
+            qs, ws = q_sum.get(tenant, 0.0), w_sum.get(tenant, 0.0)
+            preempt = p_sum.get(tenant, 0)
+            wshare = float(weights.get(tenant, 1.0)) / weight_total
+            eshare = (e_sum.get(tenant, 0.0) / exec_total
+                      if exec_total > 0 else 0.0)
+            attain_w = round(eshare / wshare, 3) if wshare > 0 else None
         if not n:
             continue
         attain = n_good / n
@@ -104,6 +139,9 @@ def build_report(session, slo_ms: float, target: float,
                "p99_ms": round(exact_quantile(lat, 0.99), 2),
                "attainment": round(attain, 5),
                "met": attain >= target,
+               "queue_share": round(qs / ws, 4) if ws > 0 else 0.0,
+               "preempted": preempt,
+               "weight_attainment": attain_w,
                "burn": {}}
         for w in windows:
             if tenant == "(all)":
@@ -131,17 +169,23 @@ def _wname(w: float) -> str:
 def print_report(rep: dict) -> None:
     wnames = [_wname(w) for w in rep["windows_s"]]
     head = (f"{'tenant':<16} {'count':>7} {'errors':>7} {'p50':>9} "
-            f"{'p95':>9} {'p99':>9} {'attain':>8} {'met':>4}"
+            f"{'p95':>9} {'p99':>9} {'attain':>8} {'met':>4} "
+            f"{'q_share':>8} {'preempt':>8} {'w_attain':>9}"
             + "".join(f" {('burn_' + n):>9}" for n in wnames))
     print(f"SLO: {rep['target']:.2%} of requests <= {rep['slo_ms']} ms "
-          "(burn = bad-fraction / error-budget; 1.0 = budget-rate)")
+          "(burn = bad-fraction / error-budget; 1.0 = budget-rate; "
+          "q_share = queue wait / wall; w_attain = exec share / "
+          "weight share)")
     print(head)
     print("-" * len(head))
     for r in rep["rows"]:
+        wa = r.get("weight_attainment")
         print(f"{r['tenant'] or '(none)':<16} {r['count']:>7} "
               f"{r['errors']:>7} {r['p50_ms']:>9.1f} {r['p95_ms']:>9.1f} "
               f"{r['p99_ms']:>9.1f} {r['attainment']:>8.4f} "
-              f"{'yes' if r['met'] else 'NO':>4}"
+              f"{'yes' if r['met'] else 'NO':>4} "
+              f"{r['queue_share']:>8.4f} {r['preempted']:>8} "
+              f"{(f'{wa:.3f}' if wa is not None else '-'):>9}"
               + "".join(f" {r['burn'][n]:>9.2f}" for n in wnames))
 
 
@@ -159,9 +203,30 @@ def main(argv=None) -> int:
     p.add_argument("--windows", default="300,3600",
                    help="comma list of burn-rate window spans in seconds "
                         "(default 300,3600 = the classic 5m+1h pair)")
+    p.add_argument("--weights", default="", metavar="T=W,...",
+                   help="tenant weights for the weight-attainment column "
+                        "(e.g. interactive=4,batch=1; unlisted tenants "
+                        "weigh 1.0 — matches ServiceConfig.tenant_weights)")
     p.add_argument("--json", default=None, metavar="PATH",
                    help="also write the report JSON here")
     a = p.parse_args(argv)
+
+    weights: dict[str, float] = {}
+    for part in a.weights.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            print(f"slo_report: bad --weights entry {part!r} "
+                  "(want tenant=weight)", file=sys.stderr)
+            return 2
+        k, _, v = part.partition("=")
+        try:
+            weights[k.strip()] = float(v)
+        except ValueError:
+            print(f"slo_report: bad --weights value {part!r}",
+                  file=sys.stderr)
+            return 2
 
     rows = []
     for path in a.log:
@@ -181,7 +246,10 @@ def main(argv=None) -> int:
     from nds_tpu.engine import Session
     session = Session(EngineConfig(use_jax=False))
     windows = [float(x) for x in a.windows.split(",") if x.strip()]
-    rep = build_report(session, a.slo_ms, a.target, windows)
+    rep = build_report(session, a.slo_ms, a.target, windows,
+                       weights=weights)
+    if weights:
+        rep["weights"] = dict(weights)
     rep["source"] = [os.path.basename(x) for x in a.log]
     rep["rows_read"] = len(rows)
     print_report(rep)

@@ -108,17 +108,17 @@ def test_ci_pipeline_script_runs():
                          text=True, check=True)
     assert out.stdout.split() == ["native", "resilience", "static",
                                   "planner", "encoded", "kernels", "mesh",
-                                  "service", "cache", "chaos", "adaptive",
-                                  "txn", "metrics_gate", "test", "bench",
-                                  "all"]
+                                  "service", "cache", "chaos", "frontdoor",
+                                  "adaptive", "txn", "metrics_gate", "test",
+                                  "bench", "all"]
     subprocess.run(["bash", script, "native"], check=True, timeout=600)
     import yaml
     with open(os.path.join(repo, "cicd", "ci.yml")) as f:
         wf = yaml.safe_load(f)
     assert set(wf["jobs"]) == {"native", "resilience", "static", "planner",
                                "encoded", "kernels", "mesh", "service",
-                               "cache", "chaos", "adaptive", "txn",
-                               "metrics_gate", "test", "bench"}
+                               "cache", "chaos", "frontdoor", "adaptive",
+                               "txn", "metrics_gate", "test", "bench"}
     for job in wf["jobs"].values():
         assert any("run_ci.sh" in str(step.get("run", ""))
                    for step in job["steps"])

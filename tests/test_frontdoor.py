@@ -1,0 +1,606 @@
+"""Arrow-IPC front door + weighted-fair scheduling + cross-process cache.
+
+The contract under test is the distributed-serving acceptance bar:
+every result a REMOTE client receives must be bit-identical to running
+the same SQL alone on a fresh single-caller Session — through the wire
+frame codec, across a real OS process boundary, under the weighted-fair
+scheduler, mid-stream at morsel-boundary preemption points, and through
+the snapshot-warmed client cache; every failure that crosses the wire
+must reconstruct as its real typed resilience class; and with every new
+knob off, the in-process service is bit-identical to before this layer
+existed with all six new counters pinned STRICT-ZERO.
+"""
+import io
+import json
+import os
+import struct
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from nds_tpu.chaos import build_demo_session, demo_pool
+from nds_tpu.obs.metrics import METRICS
+from nds_tpu.resilience import (AdmissionRejected, CircuitOpen,
+                                DeadlineExceeded, FaultError,
+                                TransientError)
+from nds_tpu.service import (ConnectionDropped, FlightClient,
+                             FrontDoorServer, QueryService, RemoteQueryError,
+                             ServiceConfig)
+from nds_tpu.service.frontdoor import (_error_doc, read_frame,
+                                       reconstruct_error, result_hash,
+                                       write_frame)
+from nds_tpu.service.service import ServiceClosed, _FairReadyQueue
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: the six counters PR 18 adds — all must stay zero on any workload that
+#: does not opt into the front door / fair queue / dedup
+NEW_COUNTERS = ("frontdoor_requests", "frontdoor_errors",
+                "service_preemptions", "service_inflight_dedup",
+                "result_cache_snapshots", "frontdoor_client_cache_hits")
+
+
+# -- frame codec --------------------------------------------------------------
+
+def _pipe():
+    return io.BytesIO()
+
+
+def test_frame_roundtrip():
+    buf = _pipe()
+    write_frame(buf, {"op": "ping", "x": [1, 2]}, b"payload")
+    buf.seek(0)
+    header, body = read_frame(buf)
+    assert header == {"op": "ping", "x": [1, 2]}
+    assert body == b"payload"
+
+
+def test_frame_empty_body():
+    buf = _pipe()
+    write_frame(buf, {"ok": True})
+    buf.seek(0)
+    _, body = read_frame(buf)
+    assert body == b""
+
+
+def test_frame_header_bound_refused():
+    buf = _pipe()
+    buf.write(struct.pack(">I", (1 << 20) + 1))
+    buf.seek(0)
+    with pytest.raises(ValueError, match="header"):
+        read_frame(buf)
+
+
+def test_frame_body_bound_refused():
+    h = json.dumps({"op": "q"}).encode()
+    buf = _pipe()
+    buf.write(struct.pack(">I", len(h)) + h
+              + struct.pack(">Q", (1 << 28) + 1))
+    buf.seek(0)
+    with pytest.raises(ValueError, match="body"):
+        read_frame(buf)
+
+
+def test_frame_eof_is_connection_dropped():
+    buf = _pipe()
+    write_frame(buf, {"op": "ping"}, b"full body here")
+    trunc = io.BytesIO(buf.getvalue()[:-5])
+    with pytest.raises(ConnectionDropped):
+        read_frame(trunc)
+
+
+# -- typed errors across the wire (unit) --------------------------------------
+
+@pytest.mark.parametrize("err", [
+    AdmissionRejected("queue full", depth=9, limit=8),
+    ServiceClosed("closing", depth=1, limit=2),
+    CircuitOpen("tripped", error_class="FaultError", retry_after_s=0.5),
+    DeadlineExceeded("budget spent"),
+    FaultError("injected"),
+    TransientError("flaky"),
+    TimeoutError("no answer"),
+])
+def test_error_reconstruction_roundtrip(err):
+    doc = json.loads(json.dumps(_error_doc(err)))   # through the wire
+    back = reconstruct_error(doc)
+    assert type(back) is type(err)
+    assert str(back) == str(err)
+    for field in ("depth", "limit", "error_class", "retry_after_s"):
+        assert getattr(back, field, None) == getattr(err, field, None)
+
+
+def test_unknown_error_class_lands_typed():
+    back = reconstruct_error({"cls": "ExoticServerError", "msg": "boom"})
+    assert isinstance(back, RemoteQueryError)
+    assert back.cls == "ExoticServerError"
+    assert "boom" in str(back)
+
+
+# -- weighted-fair ready queue (injected clock: charge() IS the clock) --------
+
+class _T:
+    """Minimal ticket stand-in."""
+
+    def __init__(self, tenant, label, streams=False):
+        self.tenant = tenant
+        self.label = label
+        self.streams = streams
+
+    def __repr__(self):
+        return self.label
+
+
+def test_fair_queue_serves_least_served_tenant():
+    q = _FairReadyQueue({"a": 1.0, "b": 1.0})
+    for i in range(2):
+        q.append(_T("a", f"a{i}"))
+        q.append(_T("b", f"b{i}"))
+    order = []
+    # charge each pop 1s: equal weights alternate a/b
+    while q:
+        t = q.popleft()
+        order.append(t.label)
+        q.charge(t.tenant, 1.0)
+    assert order == ["a0", "b0", "a1", "b1"]
+
+
+def test_fair_queue_weights_split_the_lane():
+    # weight 2 vs 1: over 6 equal-cost serves, "big" gets 4 and "small" 2
+    q = _FairReadyQueue({"big": 2.0, "small": 1.0})
+    for i in range(6):
+        q.append(_T("big", f"big{i}"))
+    for i in range(3):
+        q.append(_T("small", f"small{i}"))
+    served = []
+    for _ in range(6):
+        t = q.popleft()
+        served.append(t.tenant)
+        q.charge(t.tenant, 1.0)
+    assert served.count("big") == 4
+    assert served.count("small") == 2
+
+
+def test_fair_queue_reactivation_joins_at_floor_no_burst():
+    q = _FairReadyQueue({})
+    q.append(_T("busy", "busy0"))
+    for i in range(5):      # busy runs alone and accrues vtime
+        q.popleft()
+        q.charge("busy", 1.0)
+        q.append(_T("busy", f"busy{i + 1}"))
+    # idle tenant arrives: it must NOT owe the busy tenant's history
+    # (starvation) and must NOT get unlimited credit (burst) — it joins
+    # at the floor, then alternates fairly
+    q.append(_T("idle", "idle0"))
+    q.append(_T("idle", "idle1"))
+    first = q.popleft()
+    assert first.tenant == "idle"
+    q.charge("idle", 1.0)
+    second = q.popleft()
+    assert second.tenant == "busy"
+
+
+def test_fair_queue_pop_preemptable_skips_streamed():
+    q = _FairReadyQueue({})
+    q.append(_T("a", "stream0", streams=True))
+    q.append(_T("a", "incore0"))
+    t = q.pop_preemptable()
+    assert t.label == "incore0"
+    assert len(q) == 1          # the streamed ticket stayed queued
+    assert q.pop_preemptable() is None
+    assert q.popleft().label == "stream0"
+
+
+def test_fair_queue_deque_surface():
+    q = _FairReadyQueue({})
+    assert not q
+    with pytest.raises(IndexError):
+        q.popleft()
+    q.append(_T("a", "x"))
+    q.append(_T("b", "y"))
+    assert len(q) == 2 and bool(q)
+    assert {t.label for t in q} == {"x", "y"}
+    q.clear()
+    assert len(q) == 0
+
+
+# -- in-process wire round trip -----------------------------------------------
+
+@pytest.fixture(scope="module")
+def demo(tmp_path_factory):
+    work = str(tmp_path_factory.mktemp("fd_demo"))
+    session = build_demo_session(work)
+    pool = demo_pool()
+    baseline, hashes = {}, {}
+    # the tiny dim group-by: the cheapest real query a FRESH engine
+    # process can compile (~1.5s vs ~11s for the streamed group-by) —
+    # the subprocess round-trip tests use it to keep tier-1 wall down
+    tiny = "SELECT grp, COUNT(*) AS n FROM dim GROUP BY grp ORDER BY grp"
+    for _label, sql in pool + [("tiny#0", tiny)]:
+        table = session.sql(sql, label="base")
+        baseline[sql] = table.to_pylist()
+        hashes[sql] = result_hash(table)
+    return {"work": work, "pool": pool, "tiny": tiny,
+            "baseline": baseline, "hashes": hashes}
+
+
+def fresh_service(work_dir, **svc_kw):
+    session = build_demo_session(os.path.join(work_dir, "live"))
+    return QueryService(session, ServiceConfig(**svc_kw))
+
+
+@pytest.mark.slow  # demo-warehouse compile; CI frontdoor stage runs these
+def test_wire_round_trip_bit_identical(demo, tmp_path):
+    with fresh_service(str(tmp_path)) as svc, \
+            FrontDoorServer(svc) as door, \
+            FlightClient("127.0.0.1", door.port) as c:
+        assert c.ping()["ok"]
+        for label, sql in demo["pool"]:
+            table, hdr = c.query(sql, label=label, want_hash=True)
+            # Arrow row dicts vs engine tuples: compare values in order
+            got = [tuple(r.values()) for r in table.to_pylist()]
+            assert got == demo["baseline"][sql], label
+            assert hdr["stats"]["queue_wait_ms"] is not None
+
+
+@pytest.mark.slow
+def test_wire_typed_errors(demo, tmp_path):
+    with fresh_service(str(tmp_path)) as svc, \
+            FrontDoorServer(svc) as door, \
+            FlightClient("127.0.0.1", door.port) as c:
+        # a queued deadline of ~0 expires before the lane: the client
+        # must receive the REAL DeadlineExceeded class
+        with pytest.raises(DeadlineExceeded):
+            c.query(demo["pool"][0][1], deadline_s=1e-6)
+        # an engine-level failure with no resilience class still lands
+        # typed, carrying the server-side class name
+        with pytest.raises(RemoteQueryError) as ei:
+            c.query("SELECT nope FROM no_such_table")
+        assert ei.value.cls
+        # an unknown op is a protocol error, not a hangup
+        with pytest.raises(RemoteQueryError):
+            c._rpc({"op": "warp_drive"})
+        # the connection survived all three errors
+        assert c.ping()["ok"]
+
+
+@pytest.mark.slow
+def test_wire_closed_service_is_typed(demo, tmp_path):
+    svc = fresh_service(str(tmp_path))
+    svc.start()
+    door = FrontDoorServer(svc).start()
+    c = FlightClient("127.0.0.1", door.port)
+    try:
+        svc.close()
+        with pytest.raises(ServiceClosed):
+            c.query(demo["pool"][0][1])
+    finally:
+        c.close()
+        door.stop()
+
+
+@pytest.mark.slow
+def test_chaos_op_refused_without_allow(demo, tmp_path):
+    with fresh_service(str(tmp_path)) as svc, \
+            FrontDoorServer(svc) as door, \
+            FlightClient("127.0.0.1", door.port) as c:
+        with pytest.raises(PermissionError):
+            c.chaos(["frontdoor.drop:raise#1"])
+
+
+# -- multi-process round trip -------------------------------------------------
+
+def _spawn_server(extra, timeout_s=180.0):
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "frontdoor_server.py")] + extra,
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
+    line = proc.stdout.readline()
+    assert line.startswith("FRONTDOOR "), f"server never came up: {line!r}"
+    return proc, json.loads(line.split(" ", 1)[1])
+
+
+@pytest.mark.slow  # spawns a real server process (fresh XLA compile)
+def test_multiprocess_round_trip(demo):
+    """Two real OS client processes against one engine process: results
+    hash-identical to the in-process serial baseline (the server ships
+    its canonical engine-table hash per response)."""
+    # the join + streamed templates cross the same wire in the
+    # in-process suite above; the fresh server process gets the cheap
+    # query so this test measures the PROCESS BOUNDARY, not XLA compile
+    sql = demo["tiny"]
+    base_hash = {sql: demo["hashes"][sql]}
+    proc, info = _spawn_server(["--demo"])
+    try:
+        assert info["pid"] != os.getpid()
+        client_src = (
+            "import json,sys\n"
+            "sys.path.insert(0, %r)\n"
+            "from nds_tpu.service import FlightClient\n"
+            "c = FlightClient('127.0.0.1', %d)\n"
+            "out = {}\n"
+            "for sql in json.loads(sys.argv[1]):\n"
+            "    _t, hdr = c.query(sql, want_hash=True)\n"
+            "    out[sql] = hdr['result_hash']\n"
+            "print(json.dumps(out))\n" % (REPO, info["port"]))
+        sqls = json.dumps(list(base_hash))
+        clients = [subprocess.Popen(
+            [sys.executable, "-c", client_src, sqls],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+            for _ in range(2)]          # CONCURRENT, like real clients
+        for r in clients:
+            out, err = r.communicate(timeout=180)
+            assert r.returncode == 0, err[-800:]
+            got = json.loads(out.strip().splitlines()[-1])
+            assert got == base_hash
+    finally:
+        proc.stdin.close()
+        assert proc.wait(timeout=60) == 0
+
+
+# -- preemption: bit-identity at morsel boundaries ----------------------------
+
+@pytest.mark.slow
+def test_preemption_bit_identity(tmp_path):
+    """A streamed query preempted at morsel boundaries returns exactly
+    the bytes the unpreempted run returns, and the interactive tickets
+    served inside its yield points are exact too."""
+    stream_sql = demo_pool()[-1][1]
+    incore_sql = demo_pool()[0][1]
+    # tiny morsels: ~60 yield points across the streamed sfact scan —
+    # but the 20k-row fact table must stay IN-CORE (min_rows above it),
+    # because streamed tickets are never preemptors
+    demo_kw = dict(chunk_rows=1024, out_of_core_min_rows=30_000)
+    ref = build_demo_session(str(tmp_path / "ref"), **demo_kw)
+    want_stream = ref.sql(stream_sql, label="ref").to_pylist()
+    want_incore = ref.sql(incore_sql, label="ref").to_pylist()
+
+    session = build_demo_session(str(tmp_path / "live"), **demo_kw)
+    cfg = ServiceConfig(fair_queue=True, preemption=True,
+                        tenant_weights={"interactive": 4, "batch": 1})
+    before = METRICS.snapshot()
+    with QueryService(session, cfg) as svc:
+        # warm the in-core template so preempted dispatches adopt the
+        # shared program instead of compiling inside the yield point
+        svc.sql(incore_sql, label="warm", tenant="interactive")
+        t_stream = svc.submit(stream_sql, label="long-scan",
+                              tenant="batch")
+        # wait until the scan OWNS the lane (mark_started fired), then
+        # inject interactive arrivals: they are planned off-lane and can
+        # only complete mid-stream through its morsel-boundary yields
+        t0 = time.time()
+        while t_stream.queue_wait_ms is None and time.time() - t0 < 60:
+            time.sleep(0.001)
+        assert t_stream.queue_wait_ms is not None
+        t_int = [svc.submit(incore_sql, label=f"int{i}",
+                            tenant="interactive") for i in range(4)]
+        got_stream = t_stream.result(timeout=300).to_pylist()
+        for t in t_int:
+            assert t.result(timeout=300).to_pylist() == want_incore
+    after = METRICS.snapshot()
+    assert got_stream == want_stream
+    preempts = after.get("service_preemptions", 0) \
+        - before.get("service_preemptions", 0)
+    assert preempts >= 1, "no interactive ticket was served mid-stream"
+    assert t_stream.preempted == preempts
+
+
+@pytest.mark.slow
+def test_preempted_count_lands_in_query_log(tmp_path):
+    from nds_tpu.obs.query_log import COLUMNS
+    assert ("preempted", "int") in tuple(COLUMNS)
+
+
+# -- in-flight dedup ----------------------------------------------------------
+
+@pytest.mark.slow
+def test_inflight_dedup_leader_and_follower_share(tmp_path):
+    # dedup keys on the parameterized-plan fingerprint, which only
+    # non-streamed tickets carry — keep the 20k-row fact in-core
+    session = build_demo_session(str(tmp_path / "live"),
+                                 out_of_core_min_rows=30_000)
+    sql = demo_pool()[0][1]
+    with QueryService(session,
+                      ServiceConfig(inflight_dedup=True)) as svc:
+        svc.sql(sql, label="warm")
+        before = METRICS.snapshot()
+        with svc.hold_dispatch():
+            leader = svc.submit(sql, label="leader")
+            # wait for the leader to reach the ready queue, then the
+            # follower's identical (fp, params, gens, snap) key parks it
+            t0 = time.time()
+            while time.time() - t0 < 10:
+                with svc._cv:
+                    if len(svc._ready) >= 1:
+                        break
+                time.sleep(0.01)
+            follower = svc.submit(sql, label="follower")
+            t0 = time.time()
+            while time.time() - t0 < 10:
+                if METRICS.snapshot().get("service_inflight_dedup", 0) \
+                        > before.get("service_inflight_dedup", 0):
+                    break
+                time.sleep(0.01)
+        a = leader.result(timeout=120)
+        b = follower.result(timeout=120)
+        after = METRICS.snapshot()
+        assert a.to_pylist() == b.to_pylist()
+        assert follower.stats.mode == "deduped"
+        assert after["service_inflight_dedup"] \
+            - before.get("service_inflight_dedup", 0) == 1
+        # exactly one execution: the ready queue saw one ticket
+        assert leader.stats.mode != "deduped"
+
+
+@pytest.mark.slow
+def test_dedup_distinct_params_do_not_share(tmp_path):
+    session = build_demo_session(str(tmp_path / "live"),
+                                 out_of_core_min_rows=30_000)
+    pool = demo_pool()
+    with QueryService(session,
+                      ServiceConfig(inflight_dedup=True)) as svc:
+        before = METRICS.snapshot()
+        with svc.hold_dispatch():
+            t1 = svc.submit(pool[0][1], label="p0")
+            t2 = svc.submit(pool[1][1], label="p1")
+        t1.result(timeout=120)
+        t2.result(timeout=120)
+        after = METRICS.snapshot()
+        assert after.get("service_inflight_dedup", 0) \
+            == before.get("service_inflight_dedup", 0)
+
+
+# -- cross-process cache sharing ----------------------------------------------
+
+@pytest.mark.slow
+def test_cache_snapshot_warm_and_invalidate_on_commit(tmp_path):
+    from nds_tpu.engine.result_cache import ResultCacheConfig
+    session = build_demo_session(str(tmp_path / "live"))
+    sql = demo_pool()[0][1]
+    cfg = ServiceConfig(result_cache=ResultCacheConfig())
+    with QueryService(session, cfg) as svc, \
+            FrontDoorServer(svc) as door:
+        with FlightClient("127.0.0.1", door.port, use_cache=True) as c:
+            want = [tuple(r.values())
+                    for r in c.sql(sql, label="seed").to_pylist()]
+            before = METRICS.snapshot()
+            n = c.warm_cache()
+            assert n >= 1
+            # warmed entry revalidates True -> answered from client memory
+            table, hdr = c.query(sql, label="hit")
+            assert hdr.get("cache") == "client"
+            assert [tuple(r.values()) for r in table.to_pylist()] == want
+            after = METRICS.snapshot()
+            assert after["frontdoor_client_cache_hits"] \
+                - before.get("frontdoor_client_cache_hits", 0) == 1
+            assert after["result_cache_snapshots"] \
+                - before.get("result_cache_snapshots", 0) == 1
+
+            # a catalog commit on the engine: the warmed entry must
+            # validate FALSE on its next use and the refetched result
+            # must reflect the NEW data — never a stale serve
+            rng = np.random.default_rng(99)
+            fact2 = pa.table({
+                "fk": pa.array(rng.integers(0, 40, 5_000),
+                               type=pa.int64()),
+                "qty": pa.array(rng.integers(1, 100, 5_000),
+                                type=pa.int64()),
+            })
+            session.register_arrow("fact", fact2)
+            hits0 = METRICS.snapshot()["frontdoor_client_cache_hits"]
+            table2, hdr2 = c.query(sql, label="post-commit")
+            assert hdr2.get("cache") != "client"
+            assert METRICS.snapshot()["frontdoor_client_cache_hits"] \
+                == hits0, "stale client entry served after a commit"
+            fresh = build_demo_session(str(tmp_path / "ref"))
+            fresh.register_arrow("fact", fact2)
+            want2 = fresh.sql(sql, label="ref").to_pylist()
+            assert [tuple(r.values())
+                    for r in table2.to_pylist()] == want2
+
+
+@pytest.mark.slow
+def test_cache_epoch_mismatch_invalidates_everything(tmp_path):
+    from nds_tpu.engine.result_cache import ResultCacheConfig
+    session = build_demo_session(str(tmp_path / "live"))
+    sql = demo_pool()[0][1]
+    cfg = ServiceConfig(result_cache=ResultCacheConfig())
+    with QueryService(session, cfg) as svc:
+        with FrontDoorServer(svc) as door:
+            with FlightClient("127.0.0.1", door.port,
+                              use_cache=True) as c:
+                c.sql(sql, label="seed")
+                assert c.warm_cache() >= 1
+        # server restart: a FRESH FrontDoorServer (new epoch) over the
+        # same service — the surviving client entry must not hit
+        with FrontDoorServer(svc) as door2:
+            with FlightClient("127.0.0.1", door2.port,
+                              use_cache=True) as c2:
+                c2._cache = c._cache       # inherit the warmed set
+                hits0 = METRICS.snapshot().get(
+                    "frontdoor_client_cache_hits", 0)
+                _t, hdr = c2.query(sql, label="post-restart")
+                assert hdr.get("cache") != "client"
+                assert METRICS.snapshot().get(
+                    "frontdoor_client_cache_hits", 0) == hits0
+
+
+# -- engine-kill chaos round --------------------------------------------------
+
+@pytest.mark.slow
+def test_engine_kill_mid_query_typed(demo):
+    """frontdoor.kill hard-exits the engine process before a dispatch:
+    the client's failure is TYPED (ConnectionDropped IS-A
+    TransientError) and the exit signature proves the injected kill,
+    not a crash."""
+    proc, info = _spawn_server(["--demo", "--allow_chaos"])
+    c = FlightClient("127.0.0.1", info["port"], retries=0)
+    try:
+        c.chaos(["frontdoor.kill:raise#1"])
+        with pytest.raises(ConnectionDropped):
+            c.query(demo["pool"][0][1], label="doomed")
+        assert proc.wait(timeout=60) == 86
+    finally:
+        c.close()
+        if proc.poll() is None:
+            proc.kill()
+
+
+@pytest.mark.slow
+def test_connection_drop_retry_recovers(demo, tmp_path):
+    """frontdoor.drop severs the socket instead of replying; the client
+    reconnect-retry loop re-submits and the final answer is exact."""
+    from nds_tpu.resilience import FAULTS
+    with fresh_service(str(tmp_path)) as svc, \
+            FrontDoorServer(svc, allow_chaos=True) as door:
+        with FlightClient("127.0.0.1", door.port, retries=3) as c:
+            try:
+                # two firings: the armed drop severs the ARM reply
+                # itself first (arming still took), then the query reply
+                c.chaos(["frontdoor.drop:raise#2"])
+            except ConnectionDropped:
+                pass
+            sql = demo["pool"][0][1]
+            try:
+                table, _ = c.query(sql, label="survivor")
+            finally:
+                FAULTS.configure([])
+            got = [tuple(r.values()) for r in table.to_pylist()]
+            assert got == demo["baseline"][sql]
+
+
+# -- off-mode: bit-identical, counters STRICT-ZERO ----------------------------
+
+@pytest.mark.slow
+def test_off_mode_bit_identical_and_counters_zero(demo, tmp_path):
+    """The plain in-process service (every PR-18 knob at its default)
+    must behave exactly as before this layer existed: same results, and
+    all six new counters pinned at zero."""
+    session = build_demo_session(str(tmp_path / "live"))
+    before = METRICS.snapshot()
+    with QueryService(session, ServiceConfig()) as svc:
+        for label, sql in demo["pool"]:
+            got = svc.sql(sql, label=label).to_pylist()
+            assert got == demo["baseline"][sql], label
+    after = METRICS.snapshot()
+    for name in NEW_COUNTERS:
+        assert after.get(name, 0) == before.get(name, 0), \
+            f"{name} moved on an off-mode workload"
+
+
+@pytest.mark.slow
+def test_fair_queue_on_results_still_bit_identical(demo, tmp_path):
+    """fair_queue changes ORDER, never CONTENT."""
+    session = build_demo_session(str(tmp_path / "live"))
+    cfg = ServiceConfig(fair_queue=True,
+                        tenant_weights={"t0": 3, "t1": 1})
+    with QueryService(session, cfg) as svc:
+        tickets = [(svc.submit(sql, label=label, tenant=f"t{i % 2}"),
+                    sql)
+                   for i, (label, sql) in enumerate(demo["pool"])]
+        for t, sql in tickets:
+            assert t.result(timeout=300).to_pylist() \
+                == demo["baseline"][sql]

@@ -80,10 +80,10 @@ def test_system_table_schemas_frozen():
              "trace_id", "status", "error", "wall_ms", "queue_ms",
              "plan_ms", "exec_ms", "materialize_ms", "rows",
              "bytes_uploaded", "mode", "cache_mode", "mesh_shards",
-             "morsels", "mem_peak_bytes", "node_stats"),
+             "morsels", "mem_peak_bytes", "node_stats", "preempted"),
             ("float", "int", "str", "str", "str", "str", "int", "str",
              "str", "float", "float", "float", "float", "float", "int",
-             "int", "str", "str", "int", "int", "int", "str")),
+             "int", "str", "str", "int", "int", "int", "str", "int")),
         "system.metrics": (
             ("name", "kind", "value", "help"),
             ("str", "str", "float", "str")),

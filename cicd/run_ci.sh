@@ -6,10 +6,12 @@
 #   native     - build the C++ data generator and self-check one tiny table
 #   resilience - fast smoke of the fault-injection/retry/deadline layer
 #   static     - static analysis BEFORE anything executes: the engine-
-#                discipline lint (scripts/lint_engine.py — frozen plan IR,
-#                locked cross-thread writes) and the plan-IR verifier sweep
-#                (every bundled template through per-pass verification +
-#                seeded-corruption mutation tests, tests/test_plan_verify.py)
+#                discipline lint (python -m nds_tpu.analysis — frozen plan
+#                IR, locked cross-thread writes, lock-order deadlock
+#                detection, device-lane purity, typed-error and counter
+#                discipline) and the plan-IR verifier sweep (every bundled
+#                template through per-pass verification + seeded-corruption
+#                mutation tests, tests/test_plan_verify.py)
 #   planner    - planner/streaming tier-1: late-materialization legality/
 #                differential, capacity-ladder, shared-scan morsel fusion,
 #                narrow-lane packed-upload, and observability-layer tests
@@ -140,9 +142,12 @@ stage_resilience() {
 }
 
 stage_static() {
-    # catch rewrite bugs before they execute: lint the engine source, then
-    # sweep every bundled query template through per-pass plan verification
-    (cd "$REPO" && python scripts/lint_engine.py nds_tpu)
+    # catch rewrite bugs before they execute: the six-family engine lint
+    # (frozen plan IR, cross-thread locking, lock-order deadlock detection,
+    # device-lane purity, typed-error + counter discipline — machine-
+    # readable findings for the CI log), then sweep every bundled query
+    # template through per-pass plan verification
+    (cd "$REPO" && python -m nds_tpu.analysis --json nds_tpu)
     (cd "$REPO" && python -m pytest tests/test_plan_verify.py \
         tests/test_lint_engine.py -q)
 }

@@ -1,0 +1,132 @@
+"""Engine-discipline lint: AST-based static passes for nds_tpu/.
+
+Six rule families, all guarding invariants the runtime cannot check (or
+can only check by deadlocking/corrupting first). Pure stdlib — the CI
+``static`` stage runs ``python -m nds_tpu.analysis nds_tpu`` before
+anything executes, budgeted under 10 s for the whole tree.
+
+ENG001 — **frozen plan IR** (engine_rules). Plan nodes and bound
+  expressions are immutable everywhere; rewrite passes rebuild
+  copy-on-write because plans are DAGs — an in-place mutation on a node
+  shared by several parents silently shifts bindings for every other
+  consumer. Pragma: ``# lint: frozen-exempt (<reason>)``.
+
+ENG002 — **cross-thread writes take the lock** (engine_rules). Thread
+  targets (``Thread(target=...)``, ``pool.submit/map``) and
+  ``# lint: thread-entry``-marked entry points must write shared
+  attributes under a lock-shaped ``with``. Pragma:
+  ``# lint: lock-exempt (<reason>)``.
+
+ENG003 — **lock-order deadlock detection** (lock_order). Every
+  ``with <lock>:`` is canonicalized to the lock object it names; nested
+  acquisitions and calls into functions that (transitively) acquire add
+  edges to a whole-program acquisition graph, which must be acyclic AND
+  respect the declared hierarchy table (``lock_order.LOCK_LEVELS``:
+  ``QueryService._cv`` before ``Session._sql_lock`` before
+  ``Session._lock`` before the leaf stores before the metrics value
+  lock). Pragma: ``# lint: lock-order-exempt (<reason>)``.
+
+ENG004 — **device-lane purity** (lane). No blocking call — sleeps,
+  fsync/rename-class filesystem commits, sockets, subprocesses, file
+  writes, the project's own fsync-/wire-bound helpers — lexically inside
+  a ``# lint: device-lane``-marked function or under ``_sql_lock``: the
+  device lane is one thread and whatever blocks it stalls every tenant.
+  Pragma: ``# lint: device-lane-exempt (<reason>)``.
+
+ENG005 — **typed-error discipline** (typed_errors). Every ``raise`` in
+  the serving layer must name a class whose MRO intersects
+  ``chaos.TYPED_ERRORS``; the front door's ``reconstruct_error`` wire
+  table must be exhaustive over the contract in both directions (every
+  typed class has a branch, every branch names a live class). Pragma:
+  ``# lint: typed-error-exempt (<reason>)``.
+
+ENG006 — **counter discipline** (counters). Every metric declaration
+  carries help (the ``describe()`` glossary), every ALL_CAPS write site
+  resolves to a declaration, and the metrics gate
+  (``scripts/metrics_gate.py`` + ``cicd/metrics_baseline.json``) names
+  only live metrics while every gate-shaped metric is baselined.
+  Pragma: ``# lint: counter-exempt (<reason>)``.
+
+ENG007 — **pragma hygiene** (pragmas). Unknown pragmas, pragmas without
+  a non-empty ``(<reason>)``, suppressing pragmas whose rule no longer
+  fires on their line, and markers off a def header are all flagged.
+  No escape hatch — hygiene findings are fixed, not exempted.
+
+``scripts/lint_engine.py`` remains as a thin CLI shim for callers of the
+historical entry point; the package is the implementation.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+from .base import Finding, iter_py_files
+from .counters import check_counters
+from .engine_rules import lint_source, lint_source_all
+from .lane import check_lane_purity
+from .lock_order import check_lock_order
+from .pragmas import check_pragmas
+from .summary import ProgramSummary, summarize_source
+from .typed_errors import check_typed_errors
+
+__all__ = ["Finding", "lint_source", "lint_paths", "main"]
+
+
+def _tree_root(paths: list[str]) -> str | None:
+    """Directory holding scripts/ + cicd/ for the gate cross-check: the
+    parent of the first linted package directory."""
+    for p in paths:
+        ap = os.path.abspath(p)
+        if os.path.isdir(ap):
+            return os.path.dirname(ap)
+    if paths:
+        return os.path.dirname(os.path.dirname(os.path.abspath(paths[0])))
+    return None
+
+
+def lint_paths(paths: list[str]) -> list[Finding]:
+    """All six rule families plus pragma hygiene over ``paths``; returns
+    live (non-suppressed) findings sorted by location."""
+    findings: list[Finding] = []
+    mods = []
+    for f in iter_py_files(paths):
+        with open(f, encoding="utf-8") as fh:
+            src = fh.read()
+        mods.append(summarize_source(f, src))
+        findings += lint_source_all(f, src)
+    prog = ProgramSummary(mods)
+    findings += check_lock_order(prog)
+    findings += check_lane_purity(prog)
+    findings += check_typed_errors(prog)
+    findings += check_counters(prog, _tree_root(paths))
+    findings += check_pragmas(prog, findings)
+    live = [f for f in findings if not f.suppressed]
+    return sorted(live, key=lambda f: (f.path, f.line, f.col, f.rule))
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in args
+    args = [a for a in args if a != "--json"]
+    if not args:
+        print("usage: python -m nds_tpu.analysis [--json] <path>...",
+              file=sys.stderr)
+        return 2
+    findings = lint_paths(args)
+    if as_json:
+        counts: dict[str, int] = {}
+        for f in findings:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        print(json.dumps({"ok": not findings,
+                          "counts": counts,
+                          "findings": [f.to_dict() for f in findings]},
+                         indent=2, sort_keys=True))
+    else:
+        for f in findings:
+            print(f)
+    if findings:
+        if not as_json:
+            print(f"{len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    return 0

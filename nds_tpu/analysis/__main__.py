@@ -1,0 +1,7 @@
+"""CLI entry point: ``python -m nds_tpu.analysis [--json] <path>...``"""
+import sys
+
+from . import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,136 @@
+"""Shared lint plumbing: findings, pragma vocabulary, AST helpers.
+
+Everything in ``nds_tpu.analysis`` is pure stdlib and must stay importable
+without jax/pyarrow — the CI ``static`` stage runs it BEFORE anything
+executes, and the whole-tree run is budgeted under 10 s.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+
+#: the complete pragma vocabulary. Suppressing pragmas silence ONE rule on
+#: the line they annotate; marker pragmas declare a property of a def
+#: (thread-entry: concurrently entered, ENG002 applies; device-lane: runs
+#: on the device-lane thread, ENG004 applies). Every pragma must carry a
+#: non-empty ``(<reason>)`` — enforced by the ENG007 hygiene pass.
+PRAGMA_RULES = {
+    "frozen-exempt": "ENG001",
+    "lock-exempt": "ENG002",
+    "lock-order-exempt": "ENG003",
+    "device-lane-exempt": "ENG004",
+    "typed-error-exempt": "ENG005",
+    "counter-exempt": "ENG006",
+}
+MARKER_PRAGMAS = ("thread-entry", "device-lane")
+KNOWN_PRAGMAS = tuple(PRAGMA_RULES) + MARKER_PRAGMAS
+
+#: one regex finds every pragma occurrence with its optional reason
+PRAGMA_RE = re.compile(r"#\s*lint:\s*([a-z][a-z0-9-]*)\s*(?:\(([^)]*)\))?")
+
+
+@dataclass
+class Finding:
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+    #: the pragma string that would silence this finding (``--json``
+    #: consumers print it as the actionable escape hatch)
+    suggestion: str = ""
+    #: True when a pragma on the line suppressed it: excluded from output,
+    #: but the stale-pragma pass uses suppressed findings as evidence that
+    #: the pragma still fires
+    suppressed: bool = False
+
+    def __str__(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.rule} {self.message}")
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "pragma_suggestion": self.suggestion}
+
+
+def suggestion_for(rule: str) -> str:
+    for pragma, r in PRAGMA_RULES.items():
+        if r == rule:
+            return f"# lint: {pragma} (<reason>)"
+    return ""
+
+
+def line_pragmas(lines: list[str], lineno: int) -> list[tuple[str, str]]:
+    """[(pragma, reason)] on one 1-based source line."""
+    if not (1 <= lineno <= len(lines)):
+        return []
+    return [(m.group(1), (m.group(2) or "").strip())
+            for m in PRAGMA_RE.finditer(lines[lineno - 1])]
+
+
+def has_pragma(lines: list[str], lineno: int, pragma: str) -> bool:
+    return any(name == pragma for name, _ in line_pragmas(lines, lineno))
+
+
+def def_header_pragma(lines: list[str], node, pragma: str) -> bool:
+    """Does a def's header (decorator-free def line through the line
+    before the first body statement) carry ``pragma``? Multi-line
+    signatures keep the pragma on any header line."""
+    end = node.body[0].lineno if node.body else node.lineno
+    return any(has_pragma(lines, ln, pragma)
+               for ln in range(node.lineno, min(end, len(lines)) + 1))
+
+
+def dotted(node) -> str:
+    """Best-effort dotted name of an expression ('self._lock', '')."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def root_name(node) -> str:
+    """Leftmost Name of an attribute/subscript chain ('' when complex)."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else ""
+
+
+def lock_ctx_name(ctx_expr) -> str:
+    """Dotted name of a lock-shaped ``with`` context expression, or ''.
+
+    Recognized shapes: any dotted name ending in ``lock`` (``self._lock``,
+    ``_SHARED_LOCK``, ``session._sql_lock``), a Condition named ``*_cv``
+    (its internal lock serializes exactly like a lock), and the
+    ``METRICS.locked()`` accessor (returns the registry's shared value
+    lock)."""
+    if isinstance(ctx_expr, ast.Call):
+        d = dotted(ctx_expr.func)
+        if d.endswith(".locked") or d == "locked":
+            return d
+        return ""
+    d = dotted(ctx_expr)
+    if d.lower().endswith("lock") or d.endswith("_cv") or d == "_cv":
+        return d
+    return ""
+
+
+def iter_py_files(paths: list[str]) -> list[str]:
+    files: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for base_dir, _dirs, names in os.walk(p):
+                if "__pycache__" in base_dir:
+                    continue
+                files += [os.path.join(base_dir, n) for n in sorted(names)
+                          if n.endswith(".py")]
+        else:
+            files.append(p)
+    return files

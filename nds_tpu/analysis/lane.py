@@ -1,0 +1,86 @@
+"""ENG004 — device-lane purity: no blocking calls on the device lane.
+
+The device lane is ONE thread; anything that blocks it (an fsync-bound
+commit, a socket write, a sleep) stalls every tenant's queries at once.
+PR 16 hand-routed the transactional warehouse's fsync commits off-lane
+and PR 18 hand-routed wire serialization onto client threads; this rule
+makes that discipline static: a blocking call is flagged when it sits
+LEXICALLY
+
+- inside a function carrying the ``# lint: device-lane (<reason>)``
+  def-line marker (the service's lane loop and its dispatch helpers),
+  including nested defs; or
+- inside any ``with <...>._sql_lock:`` block anywhere in the tree — the
+  statement lock IS the lane: whoever holds it is serializing the
+  device, so blocking under it blocks the lane by proxy.
+
+``# lint: device-lane-exempt (<reason>)`` on the call line is the
+audited escape hatch.
+
+The blocking-call set is curated, not inferred: scheduler sleeps,
+fsync/rename-class filesystem commits, sockets, subprocesses, writes
+through ``open(..., 'w'/'a'/'x'/'+')``, and the project's own known
+fsync-bound / wire-bound helpers (``_atomic_write_json``,
+``write_frame``/``read_frame``). Plain reads stay legal — scans must
+read their inputs.
+"""
+from __future__ import annotations
+
+from .base import Finding, suggestion_for
+from .summary import ProgramSummary
+
+BLOCKING_DOTTED = frozenset({
+    "time.sleep", "os.fsync", "os.replace", "os.rename", "os.remove",
+    "os.unlink", "os.makedirs", "shutil.rmtree", "shutil.copy",
+    "shutil.copytree", "socket.create_connection",
+})
+BLOCKING_BARE = frozenset({
+    "sleep", "fsync", "_atomic_write_json", "write_frame", "read_frame",
+})
+BLOCKING_METHODS = frozenset({
+    "sendall", "recv", "recv_into", "accept", "fsync",
+})
+#: dotted prefixes that always block (process spawn + wait)
+BLOCKING_PREFIXES = ("subprocess.",)
+
+
+def _is_blocking(cs) -> str | None:
+    """Human-readable description of why a call blocks, or None."""
+    if cs.dot in BLOCKING_DOTTED:
+        return f"'{cs.dot}'"
+    if cs.is_bare and cs.name in BLOCKING_BARE:
+        return f"'{cs.name}'"
+    if not cs.is_bare and cs.name in BLOCKING_BARE:
+        return f"'{cs.dot or cs.name}'"
+    if cs.dot and any(cs.dot.startswith(p) for p in BLOCKING_PREFIXES):
+        return f"'{cs.dot}'"
+    if not cs.is_bare and cs.name in BLOCKING_METHODS:
+        return f"socket/file op '{cs.dot or cs.name}'"
+    if cs.name == "open" and cs.open_mode is not None and \
+            any(c in cs.open_mode for c in "wax+"):
+        return f"file write (open mode {cs.open_mode!r})"
+    return None
+
+
+def check_lane_purity(prog: ProgramSummary) -> list[Finding]:
+    findings: list[Finding] = []
+    sug = suggestion_for("ENG004")
+    for fn in prog.functions:
+        for cs in fn.calls:
+            under_sql = any(h.rsplit(".", 1)[-1] == "_sql_lock"
+                            for h in cs.held)
+            if not (cs.in_lane or under_sql):
+                continue
+            why = _is_blocking(cs)
+            if why is None:
+                continue
+            where = "under _sql_lock" if under_sql else \
+                "in a device-lane function"
+            findings.append(Finding(
+                fn.module, cs.line, 0, "ENG004",
+                f"blocking call {why} {where}: the device lane must "
+                "never wait on I/O — route this off-lane (client/"
+                "maintenance thread) like PR 16's commits and PR 18's "
+                "wire serialization, or exempt the audited site",
+                suggestion=sug, suppressed=cs.lane_exempt))
+    return findings

@@ -1,0 +1,81 @@
+"""ENG007 — pragma hygiene: every escape hatch stays audited and live.
+
+Pragmas are the lint's only escape hatches, so they get their own rule:
+
+- **unknown** — ``# lint: <name>`` outside the declared vocabulary is a
+  typo that silently silences nothing;
+- **unexplained** — every pragma must carry a non-empty ``(<reason>)``:
+  the reason IS the audit trail reviewers approved;
+- **stale suppression** — a suppressing pragma on a line where its rule
+  no longer fires is dead weight that hides future regressions on that
+  line (checkers emit suppressed findings precisely so this pass can
+  tell "still needed" from "stale" in a single run);
+- **stale marker** — ``thread-entry`` / ``device-lane`` markers are
+  meaningful only on a def header; anywhere else they declare nothing.
+
+Only real comments count: the pass tokenizes each module, so pragma
+spellings quoted in docstrings and messages (this package is full of
+them) are invisible to it.
+"""
+from __future__ import annotations
+
+import io
+import tokenize
+
+from .base import KNOWN_PRAGMAS, MARKER_PRAGMAS, PRAGMA_RE, PRAGMA_RULES, \
+    Finding
+from .summary import ProgramSummary
+
+
+def _comment_pragmas(source: str):
+    """[(line, pragma, reason)] from COMMENT tokens only."""
+    out = []
+    try:
+        toks = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in toks:
+            if tok.type != tokenize.COMMENT:
+                continue
+            for m in PRAGMA_RE.finditer(tok.string):
+                out.append((tok.start[0], m.group(1),
+                            (m.group(2) or "").strip()))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass                      # unparsable file: ENG000 covers it
+    return out
+
+
+def check_pragmas(prog: ProgramSummary,
+                  all_findings: list[Finding]) -> list[Finding]:
+    suppressed_at = {(f.path, f.line, f.rule)
+                     for f in all_findings if f.suppressed}
+    findings: list[Finding] = []
+    for m in prog.modules:
+        src = "\n".join(m.lines) + "\n"
+        for line, name, reason in _comment_pragmas(src):
+            if name not in KNOWN_PRAGMAS:
+                known = ", ".join(sorted(KNOWN_PRAGMAS))
+                findings.append(Finding(
+                    m.path, line, 0, "ENG007",
+                    f"unknown pragma 'lint: {name}': not in the "
+                    f"vocabulary ({known}) — a typo here silences "
+                    "nothing"))
+                continue
+            if not reason:
+                findings.append(Finding(
+                    m.path, line, 0, "ENG007",
+                    f"pragma 'lint: {name}' missing its (<reason>): the "
+                    "reason is the audit trail — say why this site is "
+                    "exempt"))
+            if name in PRAGMA_RULES:
+                rule = PRAGMA_RULES[name]
+                if (m.path, line, rule) not in suppressed_at:
+                    findings.append(Finding(
+                        m.path, line, 0, "ENG007",
+                        f"stale pragma 'lint: {name}': {rule} no longer "
+                        "fires on this line — remove it so a future "
+                        "regression here is not pre-silenced"))
+            elif name in MARKER_PRAGMAS and line not in m.header_lines:
+                findings.append(Finding(
+                    m.path, line, 0, "ENG007",
+                    f"misplaced marker 'lint: {name}': markers are only "
+                    "meaningful on a def header line"))
+    return findings

@@ -1,0 +1,377 @@
+"""Per-module summary pass: one AST walk per file extracts every fact the
+whole-program rules need, so ENG003-ENG006 run off summaries instead of
+re-walking trees.
+
+Per function (methods keep their enclosing class) the pass records:
+
+- lock acquisitions (``with <lock>:``): raw dotted name, the lexical
+  held-lock stack at the acquisition, and the lock-order-exempt pragma;
+- call sites with the held-lock stack, receiver shape (``self.m()`` vs
+  ``x.m()`` vs bare ``f()``), and the mode string of ``open()`` calls —
+  the lock-order propagation (ENG003) and device-lane purity (ENG004)
+  inputs;
+- raise sites with the statically-resolvable class name (ENG005);
+- whether the def carries the ``thread-entry`` / ``device-lane`` marker.
+
+Per module it also records class definitions with base-class names (the
+program-wide hierarchy ENG005 resolves typed-ness through), metric
+declarations/uses (ENG006), the ``TYPED_ERRORS`` literal, and the
+``cls == "X"`` branch strings of ``reconstruct_error`` (the wire table).
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from .base import (def_header_pragma, dotted, has_pragma, iter_py_files,
+                   lock_ctx_name, root_name)
+
+#: attribute-method names whose call is a metric write (Counter.inc,
+#: Gauge.set/dec/add, Histogram.observe)
+METRIC_WRITE_METHODS = frozenset({"inc", "dec", "add", "set", "observe"})
+METRIC_CTORS = frozenset({"counter", "gauge", "histogram"})
+
+
+@dataclass
+class LockAcq:
+    raw: str                  # dotted source spelling ('self._sql_lock')
+    line: int
+    held: tuple[str, ...]     # raw dotted names held at this acquisition
+    cls: str                  # enclosing class name ('' at module scope)
+    exempt: bool              # lock-order-exempt pragma on the line
+
+
+@dataclass
+class CallSite:
+    name: str                 # terminal name ('inc', 'sleep', 'foo')
+    dot: str                  # best-effort dotted ('time.sleep', '')
+    recv_root: str            # leftmost Name of the receiver chain
+    is_self: bool             # self.m(...) call
+    is_bare: bool             # f(...) call (no receiver)
+    line: int
+    held: tuple[str, ...]     # raw lock names held at the call
+    in_lane: bool             # lexically inside a device-lane def
+    open_mode: str | None     # literal mode of an open() call, if any
+    lock_exempt: bool         # lock-order-exempt pragma on the line
+    lane_exempt: bool         # device-lane-exempt pragma on the line
+
+
+@dataclass
+class RaiseSite:
+    cls: str | None           # 'ValueError' for raise ValueError(...);
+    line: int                 # None for bare raise / raise <variable>
+    exempt: bool              # typed-error-exempt pragma on the line
+    from_except: bool         # re-raise of a caught name
+
+
+@dataclass
+class MetricDecl:
+    name: str                 # metric name (first literal arg)
+    kind: str                 # counter | gauge | histogram
+    has_help: bool
+    const: str | None         # CONST = METRICS.counter(...) binding
+    line: int
+
+
+@dataclass
+class MetricUse:
+    const: str                # terminal ALL_CAPS receiver name
+    method: str
+    line: int
+    exempt: bool              # counter-exempt pragma on the line
+
+
+@dataclass
+class FunctionSummary:
+    module: str               # file path
+    cls: str                  # enclosing class ('' for module functions)
+    name: str
+    line: int
+    end_line: int
+    lane: bool                # device-lane marker on the def header
+    thread_entry: bool
+    locks: list[LockAcq] = field(default_factory=list)
+    calls: list[CallSite] = field(default_factory=list)
+    raises_: list[RaiseSite] = field(default_factory=list)
+
+    @property
+    def qualname(self) -> str:
+        return f"{self.cls}.{self.name}" if self.cls else self.name
+
+
+@dataclass
+class ModuleSummary:
+    path: str
+    lines: list[str]
+    functions: list[FunctionSummary] = field(default_factory=list)
+    classes: dict[str, list[str]] = field(default_factory=dict)
+    metric_decls: list[MetricDecl] = field(default_factory=list)
+    metric_uses: list[MetricUse] = field(default_factory=list)
+    typed_errors: frozenset | None = None     # TYPED_ERRORS literal
+    wire_branches: dict[str, int] | None = None   # reconstruct_error table
+    wire_table_line: int = 0
+    parse_error: tuple[int, str] | None = None
+    #: 1-based line numbers that belong to a def header (def line through
+    #: the line before the first body statement) — the only place marker
+    #: pragmas (thread-entry / device-lane) are meaningful
+    header_lines: set[int] = field(default_factory=set)
+
+
+@dataclass
+class ProgramSummary:
+    modules: list[ModuleSummary]
+
+    def __post_init__(self):
+        self.functions: list[FunctionSummary] = [
+            f for m in self.modules for f in m.functions]
+        # name -> [FunctionSummary]; methods and functions share the index
+        self.by_name: dict[str, list[FunctionSummary]] = {}
+        for f in self.functions:
+            self.by_name.setdefault(f.name, []).append(f)
+        # class -> base names (program-wide, by simple name)
+        self.class_bases: dict[str, list[str]] = {}
+        for m in self.modules:
+            for cname, bases in m.classes.items():
+                self.class_bases.setdefault(cname, bases)
+        self.typed_errors: frozenset | None = None
+        for m in self.modules:
+            if m.typed_errors is not None:
+                self.typed_errors = m.typed_errors
+                break
+
+    def ancestors(self, cls: str) -> set[str]:
+        """Transitive base-class names of ``cls`` (name-resolved across
+        the whole linted tree; builtins terminate the walk)."""
+        out: set[str] = set()
+        stack = [cls]
+        while stack:
+            c = stack.pop()
+            for b in self.class_bases.get(c, ()):  # unknown => builtin/ext
+                if b not in out:
+                    out.add(b)
+                    stack.append(b)
+        return out
+
+    def methods_of(self, cls: str, name: str) -> list[FunctionSummary]:
+        return [f for f in self.by_name.get(name, ()) if f.cls == cls]
+
+
+class _ModuleWalker(ast.NodeVisitor):
+    def __init__(self, mod: ModuleSummary):
+        self.mod = mod
+        self._class_stack: list[str] = []
+        self._fn_stack: list[FunctionSummary] = []
+        self._lock_stack: list[str] = []
+        self._lane_depth = 0
+        self._except_names: set[str] = set()
+
+    # -- structure -----------------------------------------------------------
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.mod.classes[node.name] = [
+            dotted(b).rsplit(".", 1)[-1] for b in node.bases if dotted(b)]
+        self._class_stack.append(node.name)
+        self.generic_visit(node)
+        self._class_stack.pop()
+
+    def _visit_fn(self, node) -> None:
+        lines = self.mod.lines
+        header_end = node.body[0].lineno if node.body else node.lineno
+        self.mod.header_lines.update(range(node.lineno, header_end + 1))
+        fn = FunctionSummary(
+            module=self.mod.path,
+            cls=self._class_stack[-1] if self._class_stack else "",
+            name=node.name, line=node.lineno,
+            end_line=getattr(node, "end_lineno", node.lineno) or node.lineno,
+            lane=def_header_pragma(lines, node, "device-lane"),
+            thread_entry=def_header_pragma(lines, node, "thread-entry"))
+        self.mod.functions.append(fn)
+        self._fn_stack.append(fn)
+        lane = fn.lane
+        if lane:
+            self._lane_depth += 1
+        if node.name == "reconstruct_error":
+            self._collect_wire_table(node)
+        self.generic_visit(node)
+        if lane:
+            self._lane_depth -= 1
+        self._fn_stack.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def visit_With(self, node: ast.With) -> None:
+        names = [lock_ctx_name(i.context_expr) for i in node.items]
+        names = [n for n in names if n]
+        fn = self._fn_stack[-1] if self._fn_stack else None
+        for n in names:
+            if fn is not None:
+                fn.locks.append(LockAcq(
+                    raw=n, line=node.lineno, held=tuple(self._lock_stack),
+                    cls=fn.cls,
+                    exempt=has_pragma(self.mod.lines, node.lineno,
+                                      "lock-order-exempt")))
+            self._lock_stack.append(n)
+        self.generic_visit(node)
+        for _ in names:
+            self._lock_stack.pop()
+
+    def visit_Try(self, node: ast.Try) -> None:
+        for stmt in node.body + node.orelse + node.finalbody:
+            self.visit(stmt)
+        for h in node.handlers:
+            added = h.name if h.name else None
+            if added:
+                self._except_names.add(added)
+            for stmt in h.body:
+                self.visit(stmt)
+            if added:
+                self._except_names.discard(added)
+
+    # -- facts ---------------------------------------------------------------
+    def visit_Raise(self, node: ast.Raise) -> None:
+        fn = self._fn_stack[-1] if self._fn_stack else None
+        if fn is not None:
+            cls = None
+            from_except = node.exc is None
+            exc = node.exc
+            if isinstance(exc, ast.Call):
+                d = dotted(exc.func)
+                cls = d.rsplit(".", 1)[-1] if d else None
+                if cls and not cls[:1].isupper():
+                    cls = None       # lowercase factory call: unresolvable
+            elif isinstance(exc, ast.Name):
+                if exc.id in self._except_names:
+                    from_except = True
+                elif exc.id[:1].isupper():
+                    cls = exc.id          # raise SomeError (no-arg class)
+            fn.raises_.append(RaiseSite(
+                cls=cls, line=node.lineno, from_except=from_except,
+                exempt=has_pragma(self.mod.lines, node.lineno,
+                                  "typed-error-exempt")))
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        # TYPED_ERRORS = frozenset({...}) — the typed-degradation contract
+        targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        if "TYPED_ERRORS" in targets:
+            lits = self._str_literals(node.value)
+            if lits is not None:
+                self.mod.typed_errors = frozenset(lits)
+        # CONST = METRICS.counter("name", "help")
+        if len(targets) == 1 and isinstance(node.value, ast.Call):
+            self._maybe_metric_decl(node.value, const=targets[0])
+        self.generic_visit(node)
+
+    @staticmethod
+    def _str_literals(node):
+        if isinstance(node, ast.Call) and node.args:
+            node = node.args[0]
+        if isinstance(node, (ast.Set, ast.Tuple, ast.List)):
+            vals = [e.value for e in node.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value,
+                                                                  str)]
+            return vals
+        return None
+
+    def _maybe_metric_decl(self, call: ast.Call, const: str | None) -> None:
+        if not isinstance(call.func, ast.Attribute) or \
+                call.func.attr not in METRIC_CTORS:
+            return
+        recv = dotted(call.func.value)
+        if not recv.rsplit(".", 1)[-1] == "METRICS":
+            return
+        if not (call.args and isinstance(call.args[0], ast.Constant)
+                and isinstance(call.args[0].value, str)):
+            return                       # dynamic name: out of scope
+        has_help = any(
+            isinstance(a, ast.Constant) and isinstance(a.value, str)
+            and a.value.strip() for a in call.args[1:]) or any(
+            kw.arg == "help" and isinstance(kw.value, ast.Constant)
+            and str(kw.value.value).strip() for kw in call.keywords)
+        # string-concat help ("a" "b") parses as one Constant; a
+        # help built by + or f-string still counts as present
+        if not has_help and len(call.args) > 1:
+            has_help = not (isinstance(call.args[1], ast.Constant)
+                            and not str(call.args[1].value).strip())
+        self.mod.metric_decls.append(MetricDecl(
+            name=call.args[0].value, kind=call.func.attr,
+            has_help=has_help, const=const, line=call.lineno))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self._maybe_metric_decl(node, const=None)
+        f = node.func
+        name = ""
+        dot = ""
+        recv_root = ""
+        is_self = False
+        is_bare = False
+        if isinstance(f, ast.Attribute):
+            name = f.attr
+            dot = dotted(f)
+            recv_root = root_name(f.value)
+            is_self = recv_root == "self" and isinstance(f.value, ast.Name)
+            # metric write through an ALL_CAPS constant
+            if name in METRIC_WRITE_METHODS:
+                term = dotted(f.value).rsplit(".", 1)[-1]
+                if term and term.isupper() and not term.startswith("_MET"):
+                    self.mod.metric_uses.append(MetricUse(
+                        const=term, method=name, line=node.lineno,
+                        exempt=has_pragma(self.mod.lines, node.lineno,
+                                          "counter-exempt")))
+        elif isinstance(f, ast.Name):
+            name = f.id
+            dot = f.id
+            is_bare = True
+        fn = self._fn_stack[-1] if self._fn_stack else None
+        if fn is not None and name:
+            open_mode = None
+            if name == "open":
+                if len(node.args) > 1 and \
+                        isinstance(node.args[1], ast.Constant):
+                    open_mode = str(node.args[1].value)
+                for kw in node.keywords:
+                    if kw.arg == "mode" and isinstance(kw.value,
+                                                      ast.Constant):
+                        open_mode = str(kw.value.value)
+                if open_mode is None:
+                    open_mode = "r"
+            fn.calls.append(CallSite(
+                name=name, dot=dot, recv_root=recv_root, is_self=is_self,
+                is_bare=is_bare, line=node.lineno,
+                held=tuple(self._lock_stack),
+                in_lane=self._lane_depth > 0, open_mode=open_mode,
+                lock_exempt=has_pragma(self.mod.lines, node.lineno,
+                                       "lock-order-exempt"),
+                lane_exempt=has_pragma(self.mod.lines, node.lineno,
+                                       "device-lane-exempt")))
+        self.generic_visit(node)
+
+    def _collect_wire_table(self, node) -> None:
+        branches: dict[str, int] = {}
+        for n in ast.walk(node):
+            if isinstance(n, ast.Compare) and len(n.ops) == 1 and \
+                    isinstance(n.ops[0], ast.Eq) and \
+                    isinstance(n.left, ast.Name) and n.left.id == "cls" and \
+                    isinstance(n.comparators[0], ast.Constant):
+                branches[str(n.comparators[0].value)] = n.lineno
+        self.mod.wire_branches = branches
+        self.mod.wire_table_line = node.lineno
+
+
+def summarize_source(path: str, src: str) -> ModuleSummary:
+    mod = ModuleSummary(path=path, lines=src.splitlines())
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        mod.parse_error = (e.lineno or 0, e.msg or "syntax error")
+        return mod
+    _ModuleWalker(mod).visit(tree)
+    return mod
+
+
+def summarize_paths(paths: list[str]) -> ProgramSummary:
+    mods = []
+    for f in iter_py_files(paths):
+        with open(f, encoding="utf-8") as fh:
+            mods.append(summarize_source(f, fh.read()))
+    return ProgramSummary(mods)

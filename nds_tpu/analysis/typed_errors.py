@@ -1,0 +1,113 @@
+"""ENG005 — typed-error discipline at the serving entry points.
+
+The chaos campaigns' headline invariant is "all failures typed": every
+error a client can observe must carry a class from the
+``chaos.TYPED_ERRORS`` contract (matched over the MRO, so subclasses
+count). Two static checks keep that true before anything executes:
+
+1. **Raise sites.** Every ``raise SomeClass(...)`` in the serving layer
+   (files under a ``service/`` directory — ``service.py``,
+   ``frontdoor.py``) must name a class whose MRO intersects
+   ``TYPED_ERRORS``, resolved through the program-wide class hierarchy
+   the summary pass extracts (``ConnectionDropped -> TransientError`` is
+   typed two modules away from its base). Bare re-raises and
+   ``raise caught_name`` pass through unchanged — they preserve an
+   already-classified error. ``# lint: typed-error-exempt (<reason>)``
+   covers the audited exceptions (e.g. a ValueError answered to a peer
+   that has provably lost framing).
+
+2. **Wire-table exhaustiveness, both directions.** The front door's
+   ``reconstruct_error`` branch table must cover (a) every name in
+   ``TYPED_ERRORS`` — a contract class with no branch silently arrives
+   client-side as ``RemoteQueryError``, outside the retry-policy
+   classification it was designed for; and (b) every typed-error class
+   DEFINED in the tree that any code raises — a newly added
+   ``QuotaExceeded(AdmissionRejected)`` must fail this gate until the
+   wire table learns it. Branches naming classes that no longer exist
+   anywhere (tree or builtins) are flagged as stale.
+"""
+from __future__ import annotations
+
+import builtins
+
+from .base import Finding, suggestion_for
+from .summary import ProgramSummary
+
+#: fallback contract when the linted tree does not define TYPED_ERRORS
+#: (fixture trees): raise-site checks still run against this core set
+DEFAULT_TYPED_ERRORS = frozenset({
+    "FaultError", "TransientError", "AdmissionRejected", "CircuitOpen",
+    "ServiceClosed", "DeadlineExceeded", "TimeoutError",
+})
+
+
+def _in_service_scope(path: str) -> bool:
+    norm = path.replace("\\", "/")
+    return "/service/" in norm or norm.endswith("/frontdoor.py")
+
+
+def _is_typed(cls: str, typed: frozenset, prog: ProgramSummary) -> bool:
+    if cls in typed:
+        return True
+    return bool(prog.ancestors(cls) & typed)
+
+
+def check_typed_errors(prog: ProgramSummary) -> list[Finding]:
+    typed = prog.typed_errors or DEFAULT_TYPED_ERRORS
+    findings: list[Finding] = []
+    sug = suggestion_for("ENG005")
+
+    # 1. raise sites in the serving layer
+    for fn in prog.functions:
+        if not _in_service_scope(fn.module):
+            continue
+        for rs in fn.raises_:
+            if rs.cls is None or rs.from_except:
+                continue
+            if _is_typed(rs.cls, typed, prog):
+                continue
+            findings.append(Finding(
+                fn.module, rs.line, 0, "ENG005",
+                f"raise of untyped '{rs.cls}' in the serving layer: "
+                "errors reaching clients must be (or wrap into) a "
+                "chaos.TYPED_ERRORS class so retry policies classify "
+                "them — subclass a typed base, wrap at the boundary, "
+                "or exempt the audited site",
+                suggestion=sug, suppressed=rs.exempt))
+
+    # 2. wire-table exhaustiveness (runs when the tree has the table)
+    wire_mod = next((m for m in prog.modules
+                     if m.wire_branches is not None), None)
+    if wire_mod is not None:
+        branches = wire_mod.wire_branches
+        line = wire_mod.wire_table_line
+        for name in sorted(typed):
+            if name not in branches:
+                findings.append(Finding(
+                    wire_mod.path, line, 0, "ENG005",
+                    f"wire table not exhaustive: TYPED_ERRORS class "
+                    f"'{name}' has no reconstruct_error branch — it "
+                    "would arrive client-side as RemoteQueryError, "
+                    "outside its retry classification"))
+        # every typed class defined in the tree that is actually raised
+        raised = {rs.cls for fn in prog.functions for rs in fn.raises_
+                  if rs.cls}
+        for cls in sorted(prog.class_bases):
+            if cls in branches or cls not in raised:
+                continue
+            if _is_typed(cls, typed, prog):
+                findings.append(Finding(
+                    wire_mod.path, line, 0, "ENG005",
+                    f"wire table not exhaustive: typed error class "
+                    f"'{cls}' is raised in the tree but has no "
+                    "reconstruct_error branch — it degrades to "
+                    "RemoteQueryError on the wire"))
+        # stale branches: a branch naming a class that exists nowhere
+        for name, bline in sorted(branches.items()):
+            if name in prog.class_bases or hasattr(builtins, name):
+                continue
+            findings.append(Finding(
+                wire_mod.path, bline, 0, "ENG005",
+                f"stale wire-table branch: '{name}' names a class that "
+                "no longer exists in the tree or builtins"))
+    return findings

@@ -120,12 +120,12 @@ def read_frame(rfile) -> tuple[dict, bytes]:
     ValueError (bound exceeded, malformed JSON)."""
     hlen = struct.unpack(">I", _read_exact(rfile, 4))[0]
     if hlen > MAX_HEADER_BYTES:
-        raise ValueError(f"frame header {hlen} bytes exceeds "
+        raise ValueError(f"frame header {hlen} bytes exceeds "  # lint: typed-error-exempt (framing-bound violation is deliberately NOT retryable: a typed TransientError would make clients re-send the same oversized frame; the connection is torn down instead)
                          f"bound {MAX_HEADER_BYTES}")
     header = json.loads(_read_exact(rfile, hlen).decode())
     blen = struct.unpack(">Q", _read_exact(rfile, 8))[0]
     if blen > MAX_BODY_BYTES:
-        raise ValueError(f"frame body {blen} bytes exceeds "
+        raise ValueError(f"frame body {blen} bytes exceeds "  # lint: typed-error-exempt (same deliberate non-retryable framing bound as the header check above)
                          f"bound {MAX_BODY_BYTES}")
     return header, _read_exact(rfile, blen) if blen else b""
 
@@ -183,6 +183,8 @@ def reconstruct_error(doc: dict) -> BaseException:
         return DeadlineExceeded(msg)
     if cls == "FaultError":
         return FaultError(msg)
+    if cls == "ConnectionDropped":
+        return ConnectionDropped(msg)
     if cls == "TransientError":
         return TransientError(msg)
     if cls == "TimeoutError":

@@ -410,7 +410,7 @@ class _FairReadyQueue:
     def popleft(self):
         tenant = self._pick()
         if tenant is None:
-            raise IndexError("pop from an empty ready queue")
+            raise IndexError("pop from an empty ready queue")  # lint: typed-error-exempt (deque-API contract: callers pop only after a non-empty check under _cv — this precondition error never reaches a client)
         return self._take(tenant, 0)
 
     def pop_preemptable(self):
@@ -812,7 +812,7 @@ class QueryService:
                 leader._dedup_followers.append(ticket)
             else:
                 self._inflight[key] = ticket
-                ticket._dedup_key = key  # lint: lock-exempt (written under _cv; read/cleared by _finish_ticket under _cv)
+                ticket._dedup_key = key
                 return False
         _metrics.SERVICE_INFLIGHT_DEDUP.inc()
         FLIGHT.record("dedup", label=ticket.label, tenant=ticket.tenant,
@@ -880,7 +880,7 @@ class QueryService:
                            entry.pvalues, use_jax, streams=entry.streams)
 
     # -- device lane ---------------------------------------------------------
-    def _device_loop(self) -> None:
+    def _device_loop(self) -> None:  # lint: device-lane (lane loop: the single device-dispatch thread)
         while True:
             batch = self._next_batch()
             if batch is None:
@@ -896,7 +896,7 @@ class QueryService:
                 if isinstance(e, (KeyboardInterrupt, SystemExit)):
                     raise
 
-    def _next_batch(self) -> Optional[list]:
+    def _next_batch(self) -> Optional[list]:  # lint: device-lane (runs on the device-lane thread)
         cfg = self.config
         with self._cv:
             while self._running and (self._hold or not self._ready):
@@ -904,14 +904,14 @@ class QueryService:
             if not self._running:
                 return None
         if cfg.batch_linger_ms > 0:
-            time.sleep(cfg.batch_linger_ms / 1000.0)
+            time.sleep(cfg.batch_linger_ms / 1000.0)  # lint: device-lane-exempt (the batch linger IS the lane's own coalescing window — a deliberate, config-bounded wait, not I/O)
         with self._cv:
             out = []
             while self._ready and len(out) < max(1, cfg.max_batch):
                 out.append(self._ready.popleft())
             return out
 
-    def _serve(self, batch: list) -> None:
+    def _serve(self, batch: list) -> None:  # lint: device-lane (runs on the device-lane thread)
         """Execute one drained window: expire late tickets, coalesce
         compatible parameterized plans into batched dispatches, serve the
         rest serially in arrival order."""
@@ -943,7 +943,7 @@ class QueryService:
         with self._cv:
             self._ready.charge(tenant, cost_s)
 
-    def _preempt_tick(self) -> None:
+    def _preempt_tick(self) -> None:  # lint: device-lane (runs on the device-lane thread)
         """One morsel-boundary yield point (Session._maybe_preempt calls
         here between scan groups / morsels, ON the thread that holds the
         session's statement lock mid-stream): serve up to ``preempt_max``
@@ -984,7 +984,7 @@ class QueryService:
                 return ticket
         return None
 
-    def _serve_batched(self, fp: str, members: list) -> bool:
+    def _serve_batched(self, fp: str, members: list) -> bool:  # lint: device-lane (runs on the device-lane thread)
         """One compiled program over the group's stacked parameter vectors;
         parameter-identical members deduplicate to one row. Returns False
         when batching is unavailable/drifted — the caller serves the group
@@ -1121,7 +1121,7 @@ class QueryService:
             session._finish_exec_stats(last, log=False)
         return True
 
-    def _serve_serial(self, ticket: Ticket,
+    def _serve_serial(self, ticket: Ticket,  # lint: device-lane (runs on the device-lane thread)
                       preempted: bool = False) -> None:
         """The normal Session path (record/adopt/replay, streaming,
         segmentation, host fallback) with the service's pre-built plan —
@@ -1192,7 +1192,7 @@ class QueryService:
                                     use_jax=ticket.use_jax, gens=gens)
         self._finish_ticket(ticket, result=table, stats=stats)
 
-    def _dispatch_serial(self, ticket: Ticket, preempted: bool = False):
+    def _dispatch_serial(self, ticket: Ticket, preempted: bool = False):  # lint: device-lane (runs on the device-lane thread)
         """One serial session dispatch, optionally under the device-lane
         watchdog (ServiceConfig.dispatch_timeout_s): on overrun the stuck
         worker is ABANDONED, the session swaps in fresh statement locks

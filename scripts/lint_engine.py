@@ -1,360 +1,30 @@
 #!/usr/bin/env python
-"""Engine-discipline lint: AST-based custom checks for nds_tpu/.
+"""Back-compat shim: the engine-discipline lint lives in nds_tpu.analysis.
 
-Two rule families, both guarding invariants the runtime cannot check:
+The linter grew from two per-file rules into six whole-program families
+(frozen plan IR, cross-thread locking, lock-order deadlock detection,
+device-lane purity, typed-error discipline, counter discipline — see
+``nds_tpu/analysis/__init__.py``). This file keeps the historical CLI
+and import surface alive:
 
-ENG001 — frozen plan IR. Plan nodes and bound expressions (engine/plan.py
-  dataclasses) are treated as immutable everywhere: rewrite passes rebuild
-  copy-on-write (`dataclasses.replace`), because plans are DAGs — a node
-  reachable from several parents (shared CTE subtrees, segment-cache slots)
-  that is mutated in place silently shifts positional bindings for every
-  other consumer (the `_exact_rational_keys` shared-CTE widening hazard,
-  ADVICE r5). The rule flags attribute assignments, augmented assignments,
-  subscript stores, and mutating container calls (`append`/`extend`/...)
-  whose target is a plan-IR field, EXCEPT:
-    - on objects constructed in the same function (builder-style
-      initialization of a node you provably own);
-    - `self.<field>` inside classes that are not plan-IR classes (their
-      namesake attributes are unrelated);
-    - lines carrying the pragma  `# lint: frozen-exempt (<reason>)`
-      (the whitelisted copy-on-write builders / sanctioned fresh-root
-      annotations).
+    python scripts/lint_engine.py nds_tpu          # same exit codes
+    spec_from_file_location("lint_engine", ...)    # tests load it so
 
-ENG002 — cross-thread writes take the lock. Functions handed to worker
-  threads (threading.Thread(target=...), pool.submit/map) run concurrently
-  with the session; an attribute write to shared state from such a function
-  races unless it happens under a lock (the race class PR 2's per-program
-  lock fixed by hand in CompiledQuery). Functions that are ENTERED
-  concurrently without being a literal thread target — the session entry
-  points the query service's client threads and planner workers call
-  (Session.sql, column_stats, column_enc_stats, load_table) — opt into the
-  same rule with a def-line pragma  `# lint: thread-entry (<reason>)`,
-  so the lint (not review) enforces their locking discipline. The rule
-  flags attribute writes inside thread-target/thread-entry functions (and
-  their nested closures) unless:
-    - lexically inside a `with <...lock...>:` block (any context-manager
-      expression whose dotted name ends in "lock", e.g. `self._lock`,
-      `_SHARED_LOCK` — the declared lock-protected set);
-    - the target object was created inside the function (thread-local);
-    - the line carries  `# lint: lock-exempt (<reason>)`.
-
-Pure stdlib; runs standalone:  python scripts/lint_engine.py nds_tpu
-Exit status 1 when findings exist. tests/test_lint_engine.py pins both the
-clean run over the real tree and the regression behavior (a reintroduced
-in-place PlanNode mutation and an unlocked cross-thread write are flagged).
+Everything re-exported here is the package's implementation; nothing is
+duplicated.
 """
 from __future__ import annotations
 
-import ast
-import re
+import os
 import sys
-from dataclasses import dataclass
 
-# Plan-IR dataclass fields whose names are distinctive enough to identify a
-# plan node / bound expression at a write site (engine/plan.py; keep in
-# sync when the IR grows fields). Deliberately excludes names too generic
-# to attribute (table, plan, index, dtype, name, value, op, args, extra,
-# func, arg, kind, label, key, n, all, distinct, asc, left, right).
-PLAN_FIELDS = frozenset({
-    "out_names", "out_dtypes", "child", "predicate", "exprs",
-    "left_keys", "right_keys", "residual", "null_aware", "late_mat",
-    "group_exprs", "aggs", "rollup", "rollup_levels", "funcs", "keys",
-    "columns", "partition_by", "order_by", "nulls_first", "cte_segments",
-})
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
-# classes whose OWN attributes legitimately carry plan-field names: the IR
-# dataclasses themselves (self-writes inside them are still flagged)
-IR_CLASSES = frozenset({
-    "PlanNode", "ScanNode", "FilterNode", "ProjectNode", "JoinNode",
-    "AggregateNode", "WindowNode", "SortNode", "LimitNode", "DistinctNode",
-    "SetOpNode", "MaterializedNode", "VirtualScanNode", "BExpr", "BCol",
-    "BLit", "BCall", "BParam", "BScalarSubquery", "AggSpec", "SortKey",
-    "WindowFunc",
-})
-
-MUTATOR_METHODS = frozenset({
-    "append", "extend", "insert", "pop", "remove", "clear", "sort",
-    "reverse", "update", "setdefault",
-})
-
-_FROZEN_EXEMPT = re.compile(r"#\s*lint:\s*frozen-exempt")
-_LOCK_EXEMPT = re.compile(r"#\s*lint:\s*lock-exempt")
-#: def-line pragma declaring a function concurrently entered (service
-#: client threads / planner workers) — ENG002 applies as if it were a
-#: thread target, so its shared-state writes must sit under a lock
-_THREAD_ENTRY = re.compile(r"#\s*lint:\s*thread-entry")
-
-
-@dataclass
-class Finding:
-    path: str
-    line: int
-    col: int
-    rule: str
-    message: str
-
-    def __str__(self) -> str:
-        return (f"{self.path}:{self.line}:{self.col}: "
-                f"{self.rule} {self.message}")
-
-
-def _dotted(node) -> str:
-    """Best-effort dotted name of an expression ('self._lock', '')."""
-    parts = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return ""
-
-
-def _root_name(node) -> str:
-    """Leftmost Name of an attribute/subscript chain ('' when complex)."""
-    while isinstance(node, (ast.Attribute, ast.Subscript)):
-        node = node.value
-    return node.id if isinstance(node, ast.Name) else ""
-
-
-def _is_lock_ctx(withitem: ast.withitem) -> bool:
-    d = _dotted(withitem.context_expr)
-    return d.lower().endswith("lock")
-
-
-class _FunctionInfo:
-    """Per-function facts shared by both rules."""
-
-    def __init__(self, fn: ast.AST):
-        self.fn = fn
-        # local names bound from a direct ClassName(...) constructor call:
-        # attribute writes through them are builder-style initialization
-        self.owned: set[str] = set()
-        for node in ast.walk(fn):
-            if isinstance(node, ast.Assign) and \
-                    isinstance(node.value, ast.Call) and \
-                    isinstance(node.value.func, ast.Name) and \
-                    node.value.func.id[:1].isupper():
-                for t in node.targets:
-                    if isinstance(t, ast.Name):
-                        self.owned.add(t.id)
-
-
-class _Linter(ast.NodeVisitor):
-    def __init__(self, path: str, src: str, engine_scope: bool):
-        self.path = path
-        self.lines = src.splitlines()
-        self.engine_scope = engine_scope   # rule ENG001 applies here
-        self.findings: list[Finding] = []
-        self._class_stack: list[str] = []
-        self._fn_stack: list[_FunctionInfo] = []
-        # thread-target function names collected in a pre-pass
-        self.thread_targets: set[str] = set()
-        # stack of "inside a thread-target function" markers
-        self._thread_depth = 0
-        self._lock_depth = 0
-
-    # -- helpers -------------------------------------------------------------
-    def _exempt(self, lineno: int, pattern: re.Pattern) -> bool:
-        if 1 <= lineno <= len(self.lines):
-            return bool(pattern.search(self.lines[lineno - 1]))
-        return False
-
-    def _add(self, node, rule: str, message: str) -> None:
-        self.findings.append(Finding(self.path, node.lineno,
-                                     node.col_offset, rule, message))
-
-    def _owned(self, root: str) -> bool:
-        return any(root in fi.owned for fi in self._fn_stack)
-
-    def _in_ir_class(self) -> bool:
-        return bool(self._class_stack) and \
-            self._class_stack[-1] in IR_CLASSES
-
-    # -- pre-pass: thread targets ---------------------------------------------
-    def collect_thread_targets(self, tree: ast.AST) -> None:
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            cands: list[ast.expr] = []
-            if isinstance(node.func, ast.Attribute):
-                if node.func.attr == "Thread" or \
-                        _dotted(node.func).endswith("threading.Thread"):
-                    cands += [k.value for k in node.keywords
-                              if k.arg == "target"]
-                elif node.func.attr in ("submit", "map") and node.args:
-                    # pool.submit(fn, ...) / pool.map(fn, it): first arg
-                    cands.append(node.args[0])
-            elif isinstance(node.func, ast.Name) and \
-                    node.func.id == "Thread":
-                cands += [k.value for k in node.keywords
-                          if k.arg == "target"]
-            for c in cands:
-                if isinstance(c, ast.Name):
-                    self.thread_targets.add(c.id)
-                elif isinstance(c, ast.Attribute):
-                    self.thread_targets.add(c.attr)
-
-    # -- traversal -------------------------------------------------------------
-    def visit_ClassDef(self, node: ast.ClassDef) -> None:
-        self._class_stack.append(node.name)
-        self.generic_visit(node)
-        self._class_stack.pop()
-
-    def _thread_entry_pragma(self, node) -> bool:
-        """Does the def (header lines, up to the first body statement)
-        carry the `# lint: thread-entry` pragma?"""
-        end = node.body[0].lineno if node.body else node.lineno
-        return any(_THREAD_ENTRY.search(self.lines[ln - 1])
-                   for ln in range(node.lineno, min(end, len(self.lines)) + 1)
-                   if 1 <= ln <= len(self.lines))
-
-    def _visit_fn(self, node) -> None:
-        entered_thread = node.name in self.thread_targets \
-            or self._thread_entry_pragma(node)
-        self._fn_stack.append(_FunctionInfo(node))
-        if entered_thread:
-            self._thread_depth += 1
-        self.generic_visit(node)
-        if entered_thread:
-            self._thread_depth -= 1
-        self._fn_stack.pop()
-
-    visit_FunctionDef = _visit_fn
-    visit_AsyncFunctionDef = _visit_fn
-
-    def visit_With(self, node: ast.With) -> None:
-        locked = any(_is_lock_ctx(i) for i in node.items)
-        if locked:
-            self._lock_depth += 1
-        self.generic_visit(node)
-        if locked:
-            self._lock_depth -= 1
-
-    # -- write sites ------------------------------------------------------------
-    def _check_store(self, target, stmt) -> None:
-        # unwrap subscript stores: node.out_names[0] = x mutates out_names
-        sub = target
-        while isinstance(sub, ast.Subscript):
-            sub = sub.value
-        if isinstance(sub, ast.Attribute):
-            self._check_attr_write(sub, stmt,
-                                   subscript=sub is not target)
-        # plain Name / Tuple targets mutate no object attribute
-
-    def _check_attr_write(self, attr: ast.Attribute, stmt,
-                          subscript: bool = False) -> None:
-        root = _root_name(attr.value)
-        # ENG001: frozen plan IR
-        if self.engine_scope and attr.attr in PLAN_FIELDS \
-                and not self._exempt(stmt.lineno, _FROZEN_EXEMPT):
-            allowed = (root == "self" and not self._in_ir_class()) or \
-                (root != "self" and self._owned(root))
-            if not allowed:
-                how = "subscript store into" if subscript else \
-                    "in-place assignment to"
-                self._add(stmt, "ENG001",
-                          f"{how} plan-IR field "
-                          f"'{_dotted(attr) or attr.attr}': plan nodes and "
-                          "bound expressions are frozen — rebuild "
-                          "copy-on-write (dataclasses.replace), or mark a "
-                          "sanctioned builder with "
-                          "'# lint: frozen-exempt (<reason>)'")
-        # ENG002: unlocked write from a thread-target function
-        if self._thread_depth > 0 and self._lock_depth == 0 \
-                and not self._exempt(stmt.lineno, _LOCK_EXEMPT):
-            if root and root != "self" and self._owned(root):
-                return          # thread-local object, not shared state
-            self._add(stmt, "ENG002",
-                      f"attribute write '{_dotted(attr) or attr.attr}' in "
-                      "a thread-target function outside any lock: shared "
-                      "session/streaming state must be written under its "
-                      "lock ('with <lock>:'), or mark thread-local state "
-                      "with '# lint: lock-exempt (<reason>)'")
-
-    def visit_Assign(self, node: ast.Assign) -> None:
-        for t in node.targets:
-            self._check_store(t, node)
-        self.generic_visit(node)
-
-    def visit_AugAssign(self, node: ast.AugAssign) -> None:
-        self._check_store(node.target, node)
-        self.generic_visit(node)
-
-    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
-        if node.value is not None:
-            self._check_store(node.target, node)
-        self.generic_visit(node)
-
-    def visit_Call(self, node: ast.Call) -> None:
-        # mutating container calls on plan-IR fields:
-        # node.out_names.append(x)
-        f = node.func
-        if self.engine_scope and isinstance(f, ast.Attribute) and \
-                f.attr in MUTATOR_METHODS and \
-                isinstance(f.value, ast.Attribute) and \
-                f.value.attr in PLAN_FIELDS and \
-                not self._exempt(node.lineno, _FROZEN_EXEMPT):
-            root = _root_name(f.value.value)
-            allowed = (root == "self" and not self._in_ir_class()) or \
-                (root != "self" and self._owned(root))
-            if not allowed:
-                self._add(node, "ENG001",
-                          f"mutating call '{_dotted(f)}()' on a plan-IR "
-                          "field: plan nodes are frozen — rebuild the list "
-                          "copy-on-write")
-        self.generic_visit(node)
-
-
-def lint_source(path: str, src: str,
-                engine_scope: bool | None = None) -> list[Finding]:
-    """Lint one file's source; engine_scope controls ENG001 (defaults to
-    'is this file under an engine/ directory or plan-IR heavy module')."""
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        return [Finding(path, e.lineno or 0, 0, "ENG000",
-                        f"syntax error: {e.msg}")]
-    if engine_scope is None:
-        engine_scope = True      # plan IR may be touched from anywhere
-    linter = _Linter(path, src, engine_scope)
-    linter.collect_thread_targets(tree)
-    linter.visit(tree)
-    return sorted(linter.findings, key=lambda f: (f.path, f.line, f.col))
-
-
-def lint_paths(paths: list[str]) -> list[Finding]:
-    import os
-    findings: list[Finding] = []
-    files: list[str] = []
-    for p in paths:
-        if os.path.isdir(p):
-            for base, _dirs, names in os.walk(p):
-                if "__pycache__" in base:
-                    continue
-                files += [os.path.join(base, n) for n in sorted(names)
-                          if n.endswith(".py")]
-        else:
-            files.append(p)
-    for f in files:
-        with open(f, encoding="utf-8") as fh:
-            findings += lint_source(f, fh.read())
-    return findings
-
-
-def main(argv: list[str] | None = None) -> int:
-    args = list(sys.argv[1:] if argv is None else argv)
-    if not args:
-        print("usage: lint_engine.py <path>...", file=sys.stderr)
-        return 2
-    findings = lint_paths(args)
-    for f in findings:
-        print(f)
-    if findings:
-        print(f"{len(findings)} finding(s)", file=sys.stderr)
-        return 1
-    return 0
-
+from nds_tpu.analysis import Finding, lint_paths, lint_source, main  # noqa: E402,F401,I001
+from nds_tpu.analysis.engine_rules import (  # noqa: E402,F401
+    IR_CLASSES, MUTATOR_METHODS, PLAN_FIELDS)
 
 if __name__ == "__main__":
     sys.exit(main())

@@ -1,10 +1,16 @@
-"""Engine-discipline lint (scripts/lint_engine.py): regression pins.
+"""Engine-discipline lint (nds_tpu.analysis, shim scripts/lint_engine.py).
 
 Two behaviors matter: the real tree lints CLEAN (the CI gate), and
-reintroducing either hazard class — an in-place mutation of a frozen
-PlanNode field, or an unlocked cross-thread attribute write — is flagged.
+reintroducing any hazard class is flagged with the right rule ID — an
+in-place mutation of a frozen PlanNode field (ENG001), an unlocked
+cross-thread write (ENG002), a lock-order inversion or cycle (ENG003),
+a blocking call on the device lane (ENG004), an untyped raise in the
+serving layer or a wire-table hole (ENG005), and a metrics/gate drift
+(ENG006), plus pragma hygiene (ENG007). Fixture trees exercise each
+family through the same ``lint_paths`` entry point CI uses.
 """
 import importlib.util
+import json
 import os
 import textwrap
 
@@ -194,11 +200,331 @@ def test_thread_entry_pragma_on_multiline_def():
     assert [f.rule for f in out] == ["ENG002"]
 
 
-# -- the CI gate: the real tree is clean ------------------------------------
+def _tree(tmp_path, files):
+    """Write a fixture tree and lint its pkg/ dir through the same
+    whole-program entry point CI uses; returns (findings, exit_code)."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    pkg = str(tmp_path / "pkg")
+    return LINT.lint_paths([pkg]), LINT.main([pkg])
 
-def test_nds_tpu_tree_is_clean():
+
+# -- ENG003: lock-order deadlock detection ----------------------------------
+
+def test_flags_lock_acquisition_cycle_through_calls(tmp_path):
+    """Two classes taking each other's lock while holding their own — the
+    cycle closes through the summary pass's call propagation, not any
+    single lexical nesting."""
+    findings, code = _tree(tmp_path, {"pkg/ab.py": """
+        class Alpha:
+            def touch_alpha(self):
+                with self._lock:
+                    pass
+
+            def cross(self, beta):
+                with self._lock:
+                    beta.touch_beta()
+
+        class Beta:
+            def touch_beta(self):
+                with self._lock:
+                    pass
+
+            def cross_back(self, alpha):
+                with self._lock:
+                    alpha.touch_alpha()
+    """})
+    assert code == 1
+    assert {f.rule for f in findings} == {"ENG003"}
+    assert any("cycle" in f.message for f in findings)
+
+
+def test_consistent_lock_order_is_clean(tmp_path):
+    findings, code = _tree(tmp_path, {"pkg/ab.py": """
+        class Alpha:
+            def touch_alpha(self):
+                with self._lock:
+                    pass
+
+        class Beta:
+            def cross_back(self, alpha):
+                with self._lock:
+                    alpha.touch_alpha()
+    """})
+    assert (findings, code) == ([], 0)
+
+
+def test_flags_declared_hierarchy_inversion(tmp_path):
+    """Session._lock (inner) held while taking Session._sql_lock (outer)
+    inverts the declared table — flagged even without a closing cycle."""
+    findings, code = _tree(tmp_path, {"pkg/m.py": """
+        def bad(session):
+            with session._lock:
+                with session._sql_lock:
+                    pass
+    """})
+    assert code == 1
+    assert [f.rule for f in findings] == ["ENG003"]
+    assert "inverted" in findings[0].message
+
+
+def test_declared_hierarchy_order_is_clean(tmp_path):
+    findings, code = _tree(tmp_path, {"pkg/m.py": """
+        def good(session):
+            with session._sql_lock:
+                with session._lock:
+                    pass
+    """})
+    assert (findings, code) == ([], 0)
+
+
+def test_lock_order_exempt_pragma(tmp_path):
+    findings, code = _tree(tmp_path, {"pkg/m.py": """
+        def audited(session):
+            with session._lock:
+                with session._sql_lock:  # lint: lock-order-exempt (startup only: single-threaded bootstrap)
+                    pass
+    """})
+    assert (findings, code) == ([], 0)
+
+
+# -- ENG004: device-lane purity ---------------------------------------------
+
+def test_flags_blocking_call_in_lane_function(tmp_path):
+    findings, code = _tree(tmp_path, {"pkg/svc.py": """
+        import time
+
+        class Service:
+            def _loop(self):  # lint: device-lane (dispatch thread)
+                time.sleep(0.1)
+    """})
+    assert code == 1
+    assert [f.rule for f in findings] == ["ENG004"]
+    assert "time.sleep" in findings[0].message
+
+
+def test_flags_fsync_commit_under_sql_lock(tmp_path):
+    findings, code = _tree(tmp_path, {"pkg/txn.py": """
+        import os
+
+        def commit(session, a, b):
+            with session._sql_lock:
+                os.replace(a, b)
+    """})
+    assert code == 1
+    assert [f.rule for f in findings] == ["ENG004"]
+    assert "_sql_lock" in findings[0].message
+
+
+def test_lane_reads_and_offlane_blocking_are_clean(tmp_path):
+    findings, code = _tree(tmp_path, {"pkg/svc.py": """
+        import os
+        import time
+
+        class Service:
+            def _loop(self, path):  # lint: device-lane (dispatch thread)
+                with open(path) as f:
+                    return f.read()
+
+            def maintenance(self, a, b):
+                time.sleep(0.1)
+                os.replace(a, b)
+    """})
+    assert (findings, code) == ([], 0)
+
+
+# -- ENG005: typed-error discipline -----------------------------------------
+
+def test_flags_untyped_raise_in_serving_layer(tmp_path):
+    findings, code = _tree(tmp_path, {"pkg/service/handlers.py": """
+        def handle(req):
+            raise RuntimeError("boom")
+    """})
+    assert code == 1
+    assert [f.rule for f in findings] == ["ENG005"]
+    assert "RuntimeError" in findings[0].message
+
+
+def test_typed_subclass_raise_is_clean(tmp_path):
+    """Typedness resolves through the program-wide hierarchy: a subclass
+    of TransientError defined in another module is typed."""
+    findings, code = _tree(tmp_path, {
+        "pkg/errors.py": """
+            class TransientError(Exception):
+                pass
+
+            class Flaky(TransientError):
+                pass
+        """,
+        "pkg/service/handlers.py": """
+            from ..errors import Flaky
+
+            def handle(req):
+                raise Flaky("retry me")
+        """})
+    assert (findings, code) == ([], 0)
+
+
+def test_flags_wire_table_holes_both_directions(tmp_path):
+    """A TYPED_ERRORS class without a reconstruct_error branch AND a
+    branch naming a vanished class are both flagged."""
+    findings, code = _tree(tmp_path, {"pkg/wire.py": """
+        TYPED_ERRORS = frozenset({"FaultError", "TimeoutError"})
+
+        class FaultError(Exception):
+            pass
+
+        def reconstruct_error(doc):
+            cls = doc.get("cls")
+            if cls == "FaultError":
+                return FaultError(doc.get("msg"))
+            if cls == "GoneError":
+                return RuntimeError(doc.get("msg"))
+            return RuntimeError(doc.get("msg"))
+    """})
+    assert code == 1
+    assert [f.rule for f in findings] == ["ENG005", "ENG005"]
+    msgs = " | ".join(f.message for f in findings)
+    assert "TimeoutError" in msgs and "GoneError" in msgs
+
+
+def test_wire_table_exhaustive_over_real_typed_errors():
+    """Pin: reconstruct_error covers every TYPED_ERRORS class plus the
+    tree-defined typed subclasses that cross the wire."""
+    from nds_tpu.analysis.summary import summarize_paths
+    prog = summarize_paths([
+        os.path.join(_REPO, "nds_tpu", "chaos.py"),
+        os.path.join(_REPO, "nds_tpu", "service", "frontdoor.py")])
+    wire = next(m for m in prog.modules if m.wire_branches is not None)
+    assert prog.typed_errors and \
+        prog.typed_errors <= set(wire.wire_branches)
+    assert "ConnectionDropped" in wire.wire_branches
+
+
+# -- ENG006: counter discipline ---------------------------------------------
+
+def test_flags_metric_drift_against_gate_and_glossary(tmp_path):
+    """Help-less family, unresolvable write site, orphan STRICT_ZERO row,
+    orphan baseline row, and an unbaselined gate-shaped counter — all in
+    one fixture tree shaped like the real repo layout."""
+    findings, code = _tree(tmp_path, {
+        "pkg/metrics.py": """
+            FOO_TOTAL = METRICS.counter("foo_total", "good help")
+            BAR_TOTAL = METRICS.counter("bar_total")
+
+            def bump():
+                FOO_TOTAL.inc()
+                GHOST_TOTAL.inc()
+        """,
+        "scripts/metrics_gate.py": """
+            STRICT_ZERO = ("foo_total", "vanished_total")
+        """,
+        "cicd/metrics_baseline.json": """
+            {"gated": {"foo_total": 0, "orphan_total": 0}}
+        """})
+    assert code == 1
+    assert {f.rule for f in findings} == {"ENG006"}
+    msgs = " | ".join(f.message for f in findings)
+    assert "bar_total" in msgs          # help-less + unbaselined
+    assert "GHOST_TOTAL" in msgs        # write site resolves to nothing
+    assert "vanished_total" in msgs     # orphan STRICT_ZERO row
+    assert "orphan_total" in msgs       # orphan baseline row
+
+
+def test_consistent_metrics_are_clean(tmp_path):
+    findings, code = _tree(tmp_path, {
+        "pkg/metrics.py": """
+            FOO_TOTAL = METRICS.counter("foo_total", "good help")
+            LAT_MS = METRICS.histogram("lat_ms", "latency")
+
+            def bump(v):
+                FOO_TOTAL.inc()
+                LAT_MS.observe(v)
+        """,
+        "scripts/metrics_gate.py": """
+            STRICT_ZERO = ("foo_total",)
+        """,
+        "cicd/metrics_baseline.json": """
+            {"gated": {"foo_total": 0}}
+        """})
+    assert (findings, code) == ([], 0)
+
+
+# -- ENG007: pragma hygiene --------------------------------------------------
+
+def test_flags_stale_unknown_and_unexplained_pragmas(tmp_path):
+    findings, code = _tree(tmp_path, {"pkg/m.py": """
+        def f(node, other):
+            x = 1  # lint: frozen-exempt (nothing fires here)
+            node.out_names = []  # lint: frozen-exempt
+            other.extra = 2  # lint: frozen-exemptt (typo)
+    """})
+    assert code == 1
+    by_msg = sorted((f.rule, f.message.split(":")[0]) for f in findings)
+    assert [r for r, _ in by_msg] == ["ENG007", "ENG007", "ENG007"]
+    msgs = " | ".join(f.message for f in findings)
+    assert "stale pragma" in msgs       # line 1: rule never fires there
+    assert "missing its (<reason>)" in msgs   # line 2: no reason given
+    assert "unknown pragma" in msgs     # line 3: typo'd name
+
+
+def test_docstring_pragma_mentions_are_not_pragmas(tmp_path):
+    findings, code = _tree(tmp_path, {"pkg/m.py": '''
+        def f():
+            """Docs may quote '# lint: frozen-exempt (<reason>)' freely."""
+            return 1
+    '''})
+    assert (findings, code) == ([], 0)
+
+
+# -- summary pass -------------------------------------------------------------
+
+def test_summary_records_locks_calls_and_markers():
+    from nds_tpu.analysis.summary import summarize_source
+    mod = summarize_source("m.py", textwrap.dedent("""
+        class S:
+            def work(self):  # lint: device-lane (lane)
+                with self._sql_lock:
+                    with self._lock:
+                        self.flush()
+    """))
+    fn = mod.functions[0]
+    assert (fn.cls, fn.name, fn.lane) == ("S", "work", True)
+    assert [(la.raw, la.held) for la in fn.locks] == [
+        ("self._sql_lock", ()), ("self._lock", ("self._sql_lock",))]
+    call = [c for c in fn.calls if c.name == "flush"][0]
+    assert call.is_self and call.in_lane
+    assert call.held == ("self._sql_lock", "self._lock")
+
+
+def test_summary_resolves_transitive_acquires():
+    from nds_tpu.analysis import lock_order
+    from nds_tpu.analysis.summary import summarize_source, ProgramSummary
+    prog = ProgramSummary([summarize_source("m.py", textwrap.dedent("""
+        class S:
+            def outer(self):
+                self.inner()
+
+            def inner(self):
+                with self._lock:
+                    pass
+    """))])
+    acq = lock_order._transitive_acquires(prog)
+    outer = [f for f in prog.functions if f.name == "outer"][0]
+    assert acq[id(outer)] == {"S._lock"}
+
+
+# -- the CI gate: the real tree is clean, and fast ---------------------------
+
+def test_nds_tpu_tree_is_clean_within_budget():
+    import time
+    t0 = time.perf_counter()
     findings = LINT.lint_paths([os.path.join(_REPO, "nds_tpu")])
+    wall = time.perf_counter() - t0
     assert findings == [], "\n".join(str(f) for f in findings)
+    assert wall < 10.0, f"lint took {wall:.1f}s, budget is 10s"
 
 
 def test_cli_exit_codes(tmp_path):
@@ -209,3 +535,21 @@ def test_cli_exit_codes(tmp_path):
     assert LINT.main([str(clean)]) == 0
     assert LINT.main([str(dirty)]) == 1
     assert LINT.main([]) == 2
+
+
+def test_json_output_is_machine_readable(tmp_path, capsys):
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text("def f(n):\n    n.out_dtypes = []\n")
+    assert LINT.main(["--json", str(dirty)]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["ok"] is False
+    assert doc["counts"] == {"ENG001": 1}
+    (f,) = doc["findings"]
+    assert f["rule"] == "ENG001" and f["line"] == 2
+    assert "frozen-exempt" in f["pragma_suggestion"]
+
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    assert LINT.main(["--json", str(clean)]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc == {"ok": True, "counts": {}, "findings": []}
